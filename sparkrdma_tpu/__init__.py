@@ -1,0 +1,30 @@
+"""sparkrdma_tpu — a TPU-native distributed shuffle framework.
+
+A ground-up re-design of the capabilities of SparkRDMA (Mellanox's RDMA
+ShuffleManager plugin for Apache Spark, reference layout documented in
+SURVEY.md) for TPU hardware:
+
+- map outputs stage into *registered memory*: host arenas managed by a
+  native C++ allocator plus HBM-resident ``jax.Array`` slabs
+  (reference: RdmaBuffer.java / RdmaBufferManager.java),
+- block locations ``(address, length, mkey)`` are published to a driver
+  metadata hub over a small 4-message RPC protocol
+  (reference: RdmaRpcMsg.scala / RdmaShuffleManager.scala),
+- reducers pull bytes with one-sided READs served by a passive peer IO
+  plane on the host path (reference: IBV_WR_RDMA_READ in
+  RdmaChannel.java:360-393) and by an XLA ``shard_map``/``all_to_all``
+  exchange program over ICI/DCN on the device path,
+- everything is flow-controlled, pooled, and size-classed the way the
+  reference's 100GbE operating point was tuned.
+
+Layer map (mirrors SURVEY.md §1): ``utils.config`` (L0 config),
+``memory`` + ``native`` (L3 registered memory), ``locations`` + ``rpc``
+(L4 control plane), ``transport`` (L2), ``shuffle`` (L5/L6 manager,
+writers, reader), ``engine`` (the Spark-role host engine), ``parallel``
++ ``ops`` (TPU device exchange plane), ``models`` (benchmark
+workloads).
+"""
+
+from sparkrdma_tpu.version import __version__
+
+__all__ = ["__version__"]

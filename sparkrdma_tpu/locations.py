@@ -1,0 +1,143 @@
+"""Wire types for block/partition locations and manager identity.
+
+TPU-native analogue of RdmaPartitionLocation.scala (reference:
+/root/reference/src/main/scala/org/apache/spark/shuffle/rdma/
+RdmaPartitionLocation.scala:25-147).
+
+A *block location* is the one-sided-read handle triple: in the reference
+it is ``(address: Long, length: Int, mKey: Int)`` — a raw virtual address
+plus the RDMA memory-region key. Here ``address`` is an offset within a
+registered buffer and ``mkey`` is the process-wide registry handle of
+that buffer (see sparkrdma_tpu.memory.buffer). The passive peer resolves
+``(mkey, address, length)`` without involving its application layer,
+exactly like an RDMA NIC resolves ``(rkey, addr, len)``.
+
+Serialization is fixed-width big-endian, mirroring the reference's
+DataOutputStream layout so sizes are predictable for RPC segmentation.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from io import BytesIO
+from typing import BinaryIO, List, Optional
+
+_BLOCK = struct.Struct(">QII")  # address(8) length(4) mkey(4)
+
+
+@dataclass(frozen=True)
+class BlockLocation:
+    """(address, length, mkey) — reference RdmaBlockLocation, :25."""
+
+    address: int
+    length: int
+    mkey: int
+
+    SERIALIZED_SIZE = _BLOCK.size
+
+    def write(self, out: BinaryIO) -> None:
+        out.write(_BLOCK.pack(self.address, self.length, self.mkey))
+
+    @classmethod
+    def read(cls, inp: BinaryIO) -> "BlockLocation":
+        addr, length, mkey = _BLOCK.unpack(inp.read(_BLOCK.size))
+        return cls(addr, length, mkey)
+
+
+def _write_str(out: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    out.write(struct.pack(">H", len(b)))
+    out.write(b)
+
+
+def _read_str(inp: BinaryIO) -> str:
+    (n,) = struct.unpack(">H", inp.read(2))
+    return inp.read(n).decode("utf-8")
+
+
+@dataclass(frozen=True)
+class ShuffleManagerId:
+    """Identity of one shuffle endpoint (host, port, executor_id).
+
+    Reference RdmaShuffleManagerId(host, port, blockManagerId), :61-147.
+    Equality/hash are on ``executor_id`` alone, mirroring the reference's
+    equality on blockManagerId (:128-137) so a restarted endpoint with a
+    new port replaces rather than duplicates its registry entries.
+    """
+
+    host: str
+    port: int
+    executor_id: str
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ShuffleManagerId)
+            and self.executor_id == other.executor_id
+        )
+
+    def __hash__(self) -> int:
+        return hash(self.executor_id)
+
+    def serialized_size(self) -> int:
+        return 2 + len(self.host.encode()) + 4 + 2 + len(self.executor_id.encode())
+
+    def write(self, out: BinaryIO) -> None:
+        _write_str(out, self.host)
+        out.write(struct.pack(">I", self.port))
+        _write_str(out, self.executor_id)
+
+    @classmethod
+    def read(cls, inp: BinaryIO) -> "ShuffleManagerId":
+        host = _read_str(inp)
+        (port,) = struct.unpack(">I", inp.read(4))
+        executor_id = _read_str(inp)
+        return cls(host, port, executor_id)
+
+    def to_bytes(self) -> bytes:
+        buf = BytesIO()
+        self.write(buf)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ShuffleManagerId":
+        return cls.read(BytesIO(data))
+
+
+@dataclass(frozen=True)
+class PartitionLocation:
+    """One reducer-visible block of one partition on one endpoint.
+
+    Reference RdmaPartitionLocation(rdmaShuffleManagerId, partitionId,
+    rdmaBlockLocation), :27-59.
+    """
+
+    manager_id: ShuffleManagerId
+    partition_id: int
+    block: BlockLocation
+
+    def serialized_size(self) -> int:
+        return self.manager_id.serialized_size() + 4 + BlockLocation.SERIALIZED_SIZE
+
+    def write(self, out: BinaryIO) -> None:
+        self.manager_id.write(out)
+        out.write(struct.pack(">i", self.partition_id))
+        self.block.write(out)
+
+    @classmethod
+    def read(cls, inp: BinaryIO) -> "PartitionLocation":
+        mgr = ShuffleManagerId.read(inp)
+        (pid,) = struct.unpack(">i", inp.read(4))
+        block = BlockLocation.read(inp)
+        return cls(mgr, pid, block)
+
+
+def write_locations(out: BinaryIO, locs: List[PartitionLocation]) -> None:
+    out.write(struct.pack(">I", len(locs)))
+    for loc in locs:
+        loc.write(out)
+
+
+def read_locations(inp: BinaryIO) -> List[PartitionLocation]:
+    (n,) = struct.unpack(">I", inp.read(4))
+    return [PartitionLocation.read(inp) for _ in range(n)]

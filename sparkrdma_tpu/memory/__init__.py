@@ -1,0 +1,13 @@
+from sparkrdma_tpu.memory.registry import ProtectionDomain
+from sparkrdma_tpu.memory.buffer import TpuBuffer
+from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
+from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
+from sparkrdma_tpu.memory.mapped_file import MappedFile
+
+__all__ = [
+    "ProtectionDomain",
+    "TpuBuffer",
+    "TpuBufferManager",
+    "RegisteredBuffer",
+    "MappedFile",
+]

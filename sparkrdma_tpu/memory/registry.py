@@ -1,0 +1,74 @@
+"""ProtectionDomain — the per-endpoint registered-memory handle table.
+
+TPU-native analogue of the verbs protection domain (``IbvPd``) plus
+memory-region registration (``IbvPd.regMr``) that the reference obtains
+through DiSNI (reference: RdmaNode.java:99-104 allocates the PD;
+RdmaBuffer.java:81-88 registers regions against it).
+
+Registering a region yields an ``mkey`` (the rkey/lkey analogue). A
+one-sided READ presented to this endpoint as ``(mkey, offset, length)``
+is resolved directly against this table by the transport's passive IO
+plane — the owning application code is never involved, preserving the
+reference's "remote CPU does zero per-byte work" invariant
+(SURVEY.md §5.1 #3).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class RegionError(KeyError):
+    """Access through an unknown or out-of-range (mkey, offset, length)."""
+
+
+class ProtectionDomain:
+    """Handle table: mkey → registered memoryview."""
+
+    _next_pd_id = 0
+    _pd_lock = threading.Lock()
+
+    def __init__(self):
+        with ProtectionDomain._pd_lock:
+            self.pd_id = ProtectionDomain._next_pd_id
+            ProtectionDomain._next_pd_id += 1
+        self._lock = threading.Lock()
+        self._regions: Dict[int, memoryview] = {}
+        self._next_mkey = 1  # 0 reserved as "unregistered"
+
+    def register(self, view: memoryview) -> int:
+        """Register a memory region (read-only is fine); returns its mkey."""
+        with self._lock:
+            mkey = self._next_mkey
+            self._next_mkey += 1
+            self._regions[mkey] = view
+        return mkey
+
+    def deregister(self, mkey: int) -> None:
+        with self._lock:
+            self._regions.pop(mkey, None)
+
+    def resolve(self, mkey: int, offset: int, length: int) -> memoryview:
+        """Resolve (mkey, offset, length) → memory, bounds-checked.
+
+        This is the NIC's address-translation step for an incoming READ.
+        """
+        with self._lock:
+            region = self._regions.get(mkey)
+        if region is None:
+            raise RegionError(f"mkey {mkey} not registered in pd {self.pd_id}")
+        if offset < 0 or length < 0 or offset + length > len(region):
+            raise RegionError(
+                f"READ [{offset}, {offset + length}) out of bounds for "
+                f"mkey {mkey} (region size {len(region)})"
+            )
+        return region[offset : offset + length]
+
+    def region_count(self) -> int:
+        with self._lock:
+            return len(self._regions)
+
+    def dealloc(self) -> None:
+        with self._lock:
+            self._regions.clear()

@@ -1,0 +1,66 @@
+"""Zero-copy streams over memoryviews.
+
+Analogue of ByteBufferBackedInputStream / ByteBufferBackedOutputStream
+(reference: /root/reference/src/main/java/org/apache/spark/shuffle/rdma/
+ByteBufferBacked{Input,Output}Stream.java) — minimal stream shims used
+by RPC serialization and partition reads, without copying the
+underlying registered memory.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+
+class MemoryviewInputStream(io.RawIOBase):
+    def __init__(self, view: memoryview, on_close=None):
+        self._view = view
+        self._pos = 0
+        self._on_close = on_close
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        n = min(len(b), len(self._view) - self._pos)
+        if n <= 0:
+            return 0
+        b[:n] = self._view[self._pos : self._pos + n]
+        self._pos += n
+        return n
+
+    def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            size = len(self._view) - self._pos
+        n = min(size, len(self._view) - self._pos)
+        out = bytes(self._view[self._pos : self._pos + n])
+        self._pos += n
+        return out
+
+    def close(self) -> None:
+        if not self.closed and self._on_close is not None:
+            cb, self._on_close = self._on_close, None
+            cb()
+        super().close()
+
+
+class MemoryviewOutputStream(io.RawIOBase):
+    def __init__(self, view: memoryview):
+        self._view = view
+        self._pos = 0
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        n = len(b)
+        if self._pos + n > len(self._view):
+            raise ValueError("write past end of buffer")
+        self._view[self._pos : self._pos + n] = b
+        self._pos += n
+        return n
+
+    @property
+    def position(self) -> int:
+        return self._pos

@@ -1,0 +1,3 @@
+from sparkrdma_tpu.native.arena import NativeArena, native_arena_available
+
+__all__ = ["NativeArena", "native_arena_available"]

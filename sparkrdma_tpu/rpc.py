@@ -1,0 +1,235 @@
+"""Control-plane RPC protocol: 4 message types, segmented framing.
+
+TPU-native analogue of RdmaRpcMsg.scala (reference: /root/reference/src/
+main/scala/org/apache/spark/shuffle/rdma/RdmaRpcMsg.scala).
+
+Framing (reference :42-64): a message serializes into one or more
+*segments*, each at most ``recv_wr_size`` bytes, each prefixed with a
+4-byte segment length and 4-byte message type so a receiver with fixed
+preposted receive buffers can parse every segment independently. Large
+messages (PublishPartitionLocations, AnnounceManagers) are split with a
+per-segment ``is_last`` flag; receivers accumulate until the last
+segment arrives (reference :91-161).
+
+Message types (reference RdmaRpcMsgType, :30-34):
+  - PublishPartitionLocations — writer→driver and driver→reducer pushes
+    of ``PartitionLocation`` lists.
+  - FetchPartitionLocations — reducer→driver request for one shuffle
+    partition range.
+  - ManagerHello — executor→driver introduction carrying its identity.
+  - AnnounceManagers — driver→all broadcast of full membership.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from io import BytesIO
+from typing import List, Optional, Sequence
+
+from sparkrdma_tpu.locations import (
+    PartitionLocation,
+    ShuffleManagerId,
+)
+
+SEG_HEADER = struct.Struct(">iI")  # msg_type(4) payload_len(4)
+
+
+class RpcMsgType(enum.IntEnum):
+    PUBLISH_PARTITION_LOCATIONS = 0
+    FETCH_PARTITION_LOCATIONS = 1
+    MANAGER_HELLO = 2
+    ANNOUNCE_MANAGERS = 3
+
+
+class RpcMsg:
+    """Base: a message knows how to cut itself into ≤seg_size segments."""
+
+    msg_type: RpcMsgType
+
+    def to_segments(self, seg_size: int) -> List[bytes]:
+        raise NotImplementedError
+
+    @staticmethod
+    def frame(msg_type: RpcMsgType, payload: bytes) -> bytes:
+        return SEG_HEADER.pack(int(msg_type), len(payload)) + payload
+
+    @staticmethod
+    def parse_segment(segment: bytes) -> "RpcMsg":
+        """Parse one framed segment into its message object.
+
+        Multi-segment messages come back as partial objects; the caller
+        accumulates via ``is_last`` (reference parse loop, :70-88).
+        """
+        msg_type, payload_len = SEG_HEADER.unpack_from(segment, 0)
+        payload = segment[SEG_HEADER.size : SEG_HEADER.size + payload_len]
+        t = RpcMsgType(msg_type)
+        if t == RpcMsgType.PUBLISH_PARTITION_LOCATIONS:
+            return PublishPartitionLocationsMsg.from_payload(payload)
+        if t == RpcMsgType.FETCH_PARTITION_LOCATIONS:
+            return FetchPartitionLocationsMsg.from_payload(payload)
+        if t == RpcMsgType.MANAGER_HELLO:
+            return ManagerHelloMsg.from_payload(payload)
+        if t == RpcMsgType.ANNOUNCE_MANAGERS:
+            return AnnounceManagersMsg.from_payload(payload)
+        raise ValueError(f"unknown rpc message type {msg_type}")
+
+
+@dataclass
+class PublishPartitionLocationsMsg(RpcMsg):
+    """Segmented list of partition locations for one shuffle.
+
+    Reference :91-161. ``partition_id`` is the *request* partition this
+    publish answers (driver→reducer); writers publishing their map output
+    to the driver use the sentinel -1 and the driver re-keys each
+    location by its own ``partition_id`` (reference quirk documented at
+    SURVEY.md §5.1 — preserved deliberately because the driver-side
+    re-keying makes it sound).
+    """
+
+    msg_type = RpcMsgType.PUBLISH_PARTITION_LOCATIONS
+
+    shuffle_id: int
+    partition_id: int  # -1 = writer publish; else the fetched partition
+    locations: List[PartitionLocation] = field(default_factory=list)
+    is_last: bool = True
+
+    _HDR = struct.Struct(">Bii")  # is_last(1) shuffle_id(4) partition_id(4)
+
+    def to_segments(self, seg_size: int) -> List[bytes]:
+        budget = seg_size - SEG_HEADER.size - self._HDR.size
+        if budget <= 0:
+            raise ValueError(f"segment size {seg_size} too small")
+        groups: List[List[PartitionLocation]] = [[]]
+        used = 0
+        for loc in self.locations:
+            sz = loc.serialized_size()
+            if sz > budget:
+                raise ValueError(
+                    f"partition location ({sz} bytes) exceeds segment budget {budget}"
+                )
+            if used + sz > budget and groups[-1]:
+                groups.append([])
+                used = 0
+            groups[-1].append(loc)
+            used += sz
+        segments = []
+        for i, group in enumerate(groups):
+            is_last = i == len(groups) - 1
+            buf = BytesIO()
+            buf.write(self._HDR.pack(1 if is_last else 0, self.shuffle_id, self.partition_id))
+            for loc in group:
+                loc.write(buf)
+            segments.append(self.frame(self.msg_type, buf.getvalue()))
+        return segments
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PublishPartitionLocationsMsg":
+        inp = BytesIO(payload)
+        is_last, shuffle_id, partition_id = cls._HDR.unpack(inp.read(cls._HDR.size))
+        locs = []
+        end = len(payload)
+        while inp.tell() < end:
+            locs.append(PartitionLocation.read(inp))
+        return cls(shuffle_id, partition_id, locs, bool(is_last))
+
+
+@dataclass
+class FetchPartitionLocationsMsg(RpcMsg):
+    """Reducer→driver request for locations of partitions [start, end).
+
+    Reference :163-215 fetches a single partitionId per message; the
+    range form is a strict superset that collapses the reference's
+    per-partition request loop (RdmaShuffleFetcherIterator.scala:220-320)
+    into one message per reduce task.
+    """
+
+    msg_type = RpcMsgType.FETCH_PARTITION_LOCATIONS
+
+    requester: ShuffleManagerId
+    shuffle_id: int
+    start_partition: int
+    end_partition: int
+
+    def to_segments(self, seg_size: int) -> List[bytes]:
+        buf = BytesIO()
+        self.requester.write(buf)
+        buf.write(struct.pack(">iii", self.shuffle_id, self.start_partition, self.end_partition))
+        seg = self.frame(self.msg_type, buf.getvalue())
+        if len(seg) > seg_size:
+            raise ValueError("fetch message exceeds one segment")
+        return [seg]
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchPartitionLocationsMsg":
+        inp = BytesIO(payload)
+        requester = ShuffleManagerId.read(inp)
+        shuffle_id, start, end = struct.unpack(">iii", inp.read(12))
+        return cls(requester, shuffle_id, start, end)
+
+
+@dataclass
+class ManagerHelloMsg(RpcMsg):
+    """Executor→driver introduction (reference :217-246)."""
+
+    msg_type = RpcMsgType.MANAGER_HELLO
+
+    manager_id: ShuffleManagerId
+
+    def to_segments(self, seg_size: int) -> List[bytes]:
+        seg = self.frame(self.msg_type, self.manager_id.to_bytes())
+        if len(seg) > seg_size:
+            raise ValueError("hello message exceeds one segment")
+        return [seg]
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ManagerHelloMsg":
+        return cls(ShuffleManagerId.from_bytes(payload))
+
+
+@dataclass
+class AnnounceManagersMsg(RpcMsg):
+    """Driver→all broadcast of the full membership (reference :248-307)."""
+
+    msg_type = RpcMsgType.ANNOUNCE_MANAGERS
+
+    manager_ids: List[ShuffleManagerId] = field(default_factory=list)
+    is_last: bool = True
+
+    def to_segments(self, seg_size: int) -> List[bytes]:
+        budget = seg_size - SEG_HEADER.size - 1
+        if budget <= 0:
+            raise ValueError(f"segment size {seg_size} too small")
+        groups: List[List[ShuffleManagerId]] = [[]]
+        used = 0
+        for mid in self.manager_ids:
+            sz = mid.serialized_size()
+            if sz > budget:
+                raise ValueError(
+                    f"manager id ({sz} bytes) exceeds segment budget {budget}"
+                )
+            if used + sz > budget and groups[-1]:
+                groups.append([])
+                used = 0
+            groups[-1].append(mid)
+            used += sz
+        segments = []
+        for i, group in enumerate(groups):
+            is_last = i == len(groups) - 1
+            buf = BytesIO()
+            buf.write(struct.pack(">B", 1 if is_last else 0))
+            for mid in group:
+                mid.write(buf)
+            segments.append(self.frame(self.msg_type, buf.getvalue()))
+        return segments
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "AnnounceManagersMsg":
+        inp = BytesIO(payload)
+        (is_last,) = struct.unpack(">B", inp.read(1))
+        mids = []
+        end = len(payload)
+        while inp.tell() < end:
+            mids.append(ShuffleManagerId.read(inp))
+        return cls(mids, bool(is_last))

@@ -1,0 +1,4 @@
+from sparkrdma_tpu.utils.units import parse_bytes, format_bytes
+from sparkrdma_tpu.utils.config import TpuShuffleConf, ShuffleWriterMethod
+
+__all__ = ["parse_bytes", "format_bytes", "TpuShuffleConf", "ShuffleWriterMethod"]

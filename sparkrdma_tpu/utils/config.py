@@ -1,0 +1,237 @@
+"""TpuShuffleConf — all framework tunables, range-clamped.
+
+TPU-native analogue of RdmaShuffleConf.scala (reference: /root/reference/
+src/main/scala/org/apache/spark/shuffle/rdma/RdmaShuffleConf.scala:47-126).
+Every getter clamps out-of-range values back to the default, silently,
+exactly like the reference's ``getConfKey`` helpers (:47-58). Keys are
+prefixed ``tpu.shuffle.`` (reference prefix: ``spark.shuffle.rdma.``).
+
+The defaults reproduce the reference's tuned 100GbE operating point
+(queue depths 2048/4096, 4 KiB RPC segments, 8 MiB blocks, 128 MiB
+in-flight cap, 25 GiB in-memory budget), plus TPU-only knobs for the
+device exchange plane (bucket sizes, mesh axes).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Optional
+
+from sparkrdma_tpu.utils.units import parse_bytes
+
+
+class ShuffleWriterMethod(enum.Enum):
+    """Reference: ShuffleWriterMethod enum, RdmaShuffleConf.scala:24-28."""
+
+    WRAPPER = "wrapper"
+    CHUNKED_PARTITION_AGG = "chunkedpartitionagg"
+
+    @classmethod
+    def parse(cls, s: str) -> "ShuffleWriterMethod":
+        s = s.strip().lower()
+        for m in cls:
+            if m.value == s:
+                return m
+        raise ValueError(
+            f"unknown shuffle writer method {s!r}; "
+            f"expected one of {[m.value for m in cls]}"
+        )
+
+
+PREFIX = "tpu.shuffle."
+
+
+class TpuShuffleConf:
+    """Dict-backed configuration with clamped typed getters.
+
+    Construct from any mapping of ``tpu.shuffle.*`` keys. Unknown keys are
+    kept (so higher layers can define their own), typed getters clamp to
+    [min, max] with silent fallback to the default — reference behavior at
+    RdmaShuffleConf.scala:47-58.
+    """
+
+    def __init__(self, conf: Optional[Dict[str, object]] = None):
+        self._conf: Dict[str, str] = {}
+        if conf:
+            for k, v in conf.items():
+                self._conf[str(k)] = str(v)
+
+    # -- raw access -------------------------------------------------------
+    def set(self, key: str, value: object) -> "TpuShuffleConf":
+        self._conf[key] = str(value)
+        return self
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, default)
+
+    def contains(self, key: str) -> bool:
+        return key in self._conf
+
+    def to_dict(self) -> Dict[str, str]:
+        return dict(self._conf)
+
+    # -- clamped typed getters (RdmaShuffleConf.scala:47-58) --------------
+    def _int(self, key: str, default: int, lo: int, hi: int) -> int:
+        raw = self._conf.get(PREFIX + key)
+        if raw is None:
+            return default
+        try:
+            v = int(raw)
+        except ValueError:
+            return default
+        return v if lo <= v <= hi else default
+
+    def _bytes(self, key: str, default: str, lo: int, hi: int) -> int:
+        raw = self._conf.get(PREFIX + key, default)
+        try:
+            v = parse_bytes(raw)
+        except ValueError:
+            v = parse_bytes(default)
+        if not (lo <= v <= hi):
+            v = parse_bytes(default)
+        return v
+
+    def _bool(self, key: str, default: bool) -> bool:
+        raw = self._conf.get(PREFIX + key)
+        if raw is None:
+            return default
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+
+    # -- transport queue shape (RdmaShuffleConf.scala:72-74) --------------
+    @property
+    def recv_queue_depth(self) -> int:
+        return self._int("recvQueueDepth", 2048, 256, 65535)
+
+    @property
+    def send_queue_depth(self) -> int:
+        return self._int("sendQueueDepth", 4096, 256, 65535)
+
+    @property
+    def recv_wr_size(self) -> int:
+        """RPC segment size in bytes (reference default 4 KiB)."""
+        return int(self._bytes("recvWrSize", "4k", 2048, 1 << 20))
+
+    # -- worker thread placement (RdmaShuffleConf.scala:79) ---------------
+    @property
+    def cpu_list(self) -> str:
+        return self._conf.get(PREFIX + "cpuList", "")
+
+    # -- writer strategy (RdmaShuffleConf.scala:84-93) --------------------
+    @property
+    def shuffle_writer_method(self) -> ShuffleWriterMethod:
+        raw = self._conf.get(PREFIX + "shuffleWriteMethod", "wrapper")
+        try:
+            return ShuffleWriterMethod.parse(raw)
+        except ValueError:
+            return ShuffleWriterMethod.WRAPPER
+
+    @property
+    def shuffle_write_chunk_size(self) -> int:
+        return self._bytes("shuffleWriteChunkSize", "128k", 4096, 1 << 30)
+
+    @property
+    def shuffle_write_flush_size(self) -> int:
+        return self._bytes("shuffleWriteFlushSize", "256k", 4096, 1 << 30)
+
+    @property
+    def shuffle_write_block_size(self) -> int:
+        return self._bytes("shuffleWriteBlockSize", "8m", 65536, 1 << 31)
+
+    @property
+    def shuffle_write_max_inmemory_per_executor(self) -> int:
+        return self._bytes(
+            "shuffleWriteMaxInMemoryStoragePerExecutor", "25g", 0, 1 << 44
+        )
+
+    # -- read path (RdmaShuffleConf.scala:99-104) -------------------------
+    @property
+    def shuffle_read_block_size(self) -> int:
+        return self._bytes("shuffleReadBlockSize", "8m", 65536, 1 << 31)
+
+    @property
+    def max_bytes_in_flight(self) -> int:
+        return self._bytes("maxBytesInFlight", "128m", 65536, 1 << 40)
+
+    @property
+    def max_agg_block(self) -> int:
+        return self._bytes("maxAggBlock", "2m", 65536, 1 << 31)
+
+    @property
+    def max_agg_prealloc(self) -> int:
+        return self._int("maxAggPrealloc", 0, 0, 1 << 20)
+
+    # -- reader stats (RdmaShuffleConf.scala:106-113) ---------------------
+    @property
+    def collect_shuffle_read_stats(self) -> bool:
+        return self._bool("collectShuffleReadStats", False)
+
+    @property
+    def fetch_time_num_buckets(self) -> int:
+        return self._int("fetchTimeNumBuckets", 5, 1, 1000)
+
+    @property
+    def fetch_time_bucket_size_ms(self) -> int:
+        return self._int("fetchTimeBucketSizeInMs", 300, 1, 1 << 30)
+
+    # -- endpoints / connection management (RdmaShuffleConf.scala:118-126)
+    @property
+    def driver_host(self) -> str:
+        return self._conf.get(PREFIX + "driverHost", "127.0.0.1")
+
+    @property
+    def driver_port(self) -> int:
+        return self._int("driverPort", 0, 0, 65535)
+
+    def set_driver_port(self, port: int) -> None:
+        """Write back the negotiated listener port so executors inherit it.
+
+        Reference: the single mutable key, RdmaShuffleConf.scala:67 /
+        RdmaShuffleManager.scala:183-184.
+        """
+        self._conf[PREFIX + "driverPort"] = str(port)
+
+    @property
+    def executor_port(self) -> int:
+        return self._int("executorPort", 0, 0, 65535)
+
+    @property
+    def port_max_retries(self) -> int:
+        return self._int("portMaxRetries", 16, 1, 1024)
+
+    @property
+    def connect_timeout_ms(self) -> int:
+        """CM-event analogue timeout (reference rdmaCmEventTimeout 20s)."""
+        return self._int("connectTimeoutMs", 20000, 100, 1 << 30)
+
+    @property
+    def teardown_timeout_ms(self) -> int:
+        return self._int("teardownListenTimeoutMs", 50, 1, 1 << 30)
+
+    @property
+    def max_connection_attempts(self) -> int:
+        return self._int("maxConnectionAttempts", 5, 1, 100)
+
+    @property
+    def fetch_location_timeout_ms(self) -> int:
+        """Timeout for driver location fetches (fetcher iterator wrapper)."""
+        return self._int("partitionLocationFetchTimeoutMs", 30000, 100, 1 << 30)
+
+    # -- TPU device exchange plane (new; no reference analogue) -----------
+    @property
+    def exchange_bucket_min(self) -> int:
+        """Smallest padded block bucket for the static-shape exchange program."""
+        return self._bytes("exchange.bucketMin", "64k", 1024, 1 << 31)
+
+    @property
+    def exchange_bucket_max(self) -> int:
+        return self._bytes("exchange.bucketMax", "8m", 1024, 1 << 33)
+
+    @property
+    def hbm_slab_bytes(self) -> int:
+        """Size of each HBM staging slab owned by the device buffer manager."""
+        return self._bytes("hbm.slabBytes", "64m", 1 << 16, 1 << 33)
+
+    @property
+    def hbm_max_bytes(self) -> int:
+        """HBM budget for shuffle staging (analogue of the 25g host budget)."""
+        return self._bytes("hbm.maxBytes", "2g", 0, 1 << 40)

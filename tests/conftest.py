@@ -1,0 +1,16 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's missing-but-implied multi-node-without-a-cluster
+strategy (SURVEY.md §4): all sharding/collective tests run on
+``--xla_force_host_platform_device_count=8`` CPU devices so CI needs no
+TPU slice.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,105 @@
+"""Buffer plane tests: pool reuse/leak accounting and slice refcounts —
+properties the reference implies but never checks (SURVEY.md §4:
+RdmaBufferManager.java:131-141, RdmaRegisteredBuffer.java:52-107)."""
+
+import pytest
+
+from sparkrdma_tpu.memory import (
+    ProtectionDomain,
+    RegisteredBuffer,
+    TpuBuffer,
+    TpuBufferManager,
+)
+from sparkrdma_tpu.memory.buffer_manager import MIN_BLOCK_SIZE, next_power_of_2
+
+
+def test_power_of_two_rounding():
+    assert next_power_of_2(1) == MIN_BLOCK_SIZE
+    assert next_power_of_2(MIN_BLOCK_SIZE) == MIN_BLOCK_SIZE
+    assert next_power_of_2(MIN_BLOCK_SIZE + 1) == 2 * MIN_BLOCK_SIZE
+    assert next_power_of_2(100_000) == 131072
+
+
+def test_buffer_write_read_and_registration():
+    pd = ProtectionDomain()
+    buf = TpuBuffer(pd, 1024)
+    assert buf.mkey != 0
+    buf.write(b"hello world", offset=100)
+    assert buf.read(100, 11) == b"hello world"
+    # the PD resolves one-sided reads into this region
+    assert bytes(pd.resolve(buf.mkey, 100, 11)) == b"hello world"
+    buf.free()
+    with pytest.raises(KeyError):
+        pd.resolve(buf.mkey, 0, 1)
+
+
+def test_pd_bounds_check():
+    pd = ProtectionDomain()
+    buf = TpuBuffer(pd, 1024)
+    with pytest.raises(KeyError):
+        pd.resolve(buf.mkey, 1000, 100)
+    buf.free()
+
+
+def test_pool_reuse():
+    pd = ProtectionDomain()
+    mgr = TpuBufferManager(pd)
+    a = mgr.get(10_000)
+    assert a.length == MIN_BLOCK_SIZE  # rounded up to 16 KiB floor
+    mgr.put(a)
+    b = mgr.get(16_000)
+    assert b is a  # LIFO reuse from the same size class
+    assert mgr.stats()[MIN_BLOCK_SIZE] == 1  # only one real allocation
+    mgr.stop()
+
+
+def test_pool_prealloc():
+    pd = ProtectionDomain()
+    mgr = TpuBufferManager(pd, is_executor=True, max_agg_block=1 << 20, max_agg_prealloc=4)
+    assert mgr.stats()[1 << 20] == 4
+    bufs = [mgr.get(1 << 20) for _ in range(4)]
+    assert mgr.stats()[1 << 20] == 4  # served from prealloc, no new allocs
+    for buf in bufs:
+        mgr.put(buf)
+    mgr.stop()
+
+
+def test_registered_buffer_slices_and_refcount():
+    pd = ProtectionDomain()
+    mgr = TpuBufferManager(pd)
+    rb = RegisteredBuffer(mgr, 32 * 1024)
+    s1 = rb.slice(1000)
+    s2 = rb.slice(2000)
+    assert s1.address == 0 and s2.address == 1000
+    assert s1.mkey == s2.mkey == rb.mkey
+    s1.view[:] = b"a" * 1000
+    s2.view[:] = b"b" * 2000
+    # slices resolve through the PD at their published (mkey, address)
+    assert bytes(pd.resolve(s1.mkey, s1.address, 4)) == b"aaaa"
+    assert bytes(pd.resolve(s2.mkey, s2.address, 4)) == b"bbbb"
+    assert rb.ref_count() == 2
+    s1.release()
+    assert rb.ref_count() == 1
+    s2.release()  # refcount 0 → returned to pool
+    assert rb.ref_count() == 0
+    reused = mgr.get(32 * 1024)
+    assert reused.length == 32 * 1024
+    mgr.stop()
+
+
+def test_native_arena_stats_if_available():
+    from sparkrdma_tpu.native.arena import NativeArena, native_arena_available
+
+    if not native_arena_available():
+        pytest.skip("native arena toolchain unavailable")
+    arena = NativeArena.shared()
+    total0, live0, count0 = arena.stats()
+    aid, view = arena.alloc(4096)
+    view[:5] = b"abcde"
+    assert bytes(view[:5]) == b"abcde"
+    total1, live1, count1 = arena.stats()
+    assert total1 == total0 + 1 and count1 == count0 + 1
+    del view
+    arena.free(aid)
+    _, live2, count2 = arena.stats()
+    assert count2 == count0 and live2 == live0

@@ -1,0 +1,52 @@
+from sparkrdma_tpu.utils.config import ShuffleWriterMethod, TpuShuffleConf
+from sparkrdma_tpu.utils.units import format_bytes, parse_bytes
+
+
+def test_parse_bytes():
+    assert parse_bytes("4k") == 4096
+    assert parse_bytes("8m") == 8 << 20
+    assert parse_bytes("25g") == 25 << 30
+    assert parse_bytes("123") == 123
+    assert parse_bytes(42) == 42
+    assert parse_bytes("1kb") == 1024
+    assert format_bytes(8 << 20) == "8m"
+
+
+def test_defaults_match_reference_operating_point():
+    c = TpuShuffleConf()
+    assert c.recv_queue_depth == 2048
+    assert c.send_queue_depth == 4096
+    assert c.recv_wr_size == 4096
+    assert c.shuffle_write_chunk_size == 128 << 10
+    assert c.shuffle_write_flush_size == 256 << 10
+    assert c.shuffle_write_block_size == 8 << 20
+    assert c.shuffle_write_max_inmemory_per_executor == 25 << 30
+    assert c.shuffle_read_block_size == 8 << 20
+    assert c.max_bytes_in_flight == 128 << 20
+    assert c.max_agg_block == 2 << 20
+    assert c.max_agg_prealloc == 0
+    assert c.shuffle_writer_method == ShuffleWriterMethod.WRAPPER
+    assert not c.collect_shuffle_read_stats
+
+
+def test_out_of_range_clamps_to_default():
+    c = TpuShuffleConf({"tpu.shuffle.recvQueueDepth": "10"})  # below min 256
+    assert c.recv_queue_depth == 2048
+    c = TpuShuffleConf({"tpu.shuffle.recvQueueDepth": "garbage"})
+    assert c.recv_queue_depth == 2048
+    c = TpuShuffleConf({"tpu.shuffle.recvQueueDepth": "512"})
+    assert c.recv_queue_depth == 512
+
+
+def test_writer_method_parse():
+    c = TpuShuffleConf({"tpu.shuffle.shuffleWriteMethod": "ChunkedPartitionAgg"})
+    assert c.shuffle_writer_method == ShuffleWriterMethod.CHUNKED_PARTITION_AGG
+    c = TpuShuffleConf({"tpu.shuffle.shuffleWriteMethod": "bogus"})
+    assert c.shuffle_writer_method == ShuffleWriterMethod.WRAPPER
+
+
+def test_driver_port_writeback():
+    c = TpuShuffleConf()
+    assert c.driver_port == 0
+    c.set_driver_port(12345)
+    assert c.driver_port == 12345

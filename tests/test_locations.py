@@ -1,0 +1,42 @@
+from io import BytesIO
+
+from sparkrdma_tpu.locations import (
+    BlockLocation,
+    PartitionLocation,
+    ShuffleManagerId,
+    read_locations,
+    write_locations,
+)
+
+
+def test_block_location_roundtrip():
+    loc = BlockLocation(address=0xDEADBEEF00, length=12345, mkey=7)
+    buf = BytesIO()
+    loc.write(buf)
+    assert buf.tell() == BlockLocation.SERIALIZED_SIZE
+    buf.seek(0)
+    assert BlockLocation.read(buf) == loc
+
+
+def test_manager_id_roundtrip_and_equality():
+    a = ShuffleManagerId("host-a.example", 4440, "exec-1")
+    b = ShuffleManagerId("host-b.example", 9999, "exec-1")
+    c = ShuffleManagerId("host-a.example", 4440, "exec-2")
+    # equality/hash on executor_id only (reference equality on blockManagerId)
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+    assert ShuffleManagerId.from_bytes(a.to_bytes()) == a
+    rt = ShuffleManagerId.from_bytes(a.to_bytes())
+    assert (rt.host, rt.port, rt.executor_id) == (a.host, a.port, a.executor_id)
+    assert len(a.to_bytes()) == a.serialized_size()
+
+
+def test_partition_location_list_roundtrip():
+    mid = ShuffleManagerId("h", 1, "e0")
+    locs = [
+        PartitionLocation(mid, i, BlockLocation(i * 100, i, i + 1)) for i in range(10)
+    ]
+    buf = BytesIO()
+    write_locations(buf, locs)
+    buf.seek(0)
+    assert read_locations(buf) == locs

@@ -1,0 +1,78 @@
+"""RPC segmentation round-trip — the property the reference implies but
+never checks (SURVEY.md §4: RdmaRpcMsg.scala:48-64 vs 142-152)."""
+
+from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleManagerId
+from sparkrdma_tpu.rpc import (
+    AnnounceManagersMsg,
+    FetchPartitionLocationsMsg,
+    ManagerHelloMsg,
+    PublishPartitionLocationsMsg,
+    RpcMsg,
+)
+
+MID = ShuffleManagerId("localhost", 43210, "exec-7")
+
+
+def test_hello_roundtrip():
+    msg = ManagerHelloMsg(MID)
+    segs = msg.to_segments(4096)
+    assert len(segs) == 1
+    parsed = RpcMsg.parse_segment(segs[0])
+    assert isinstance(parsed, ManagerHelloMsg)
+    assert parsed.manager_id == MID
+    assert parsed.manager_id.port == 43210
+
+
+def test_fetch_roundtrip():
+    msg = FetchPartitionLocationsMsg(MID, shuffle_id=3, start_partition=5, end_partition=9)
+    parsed = RpcMsg.parse_segment(msg.to_segments(4096)[0])
+    assert isinstance(parsed, FetchPartitionLocationsMsg)
+    assert (parsed.shuffle_id, parsed.start_partition, parsed.end_partition) == (3, 5, 9)
+    assert parsed.requester == MID
+
+
+def test_publish_single_segment():
+    locs = [PartitionLocation(MID, 0, BlockLocation(0, 10, 1))]
+    msg = PublishPartitionLocationsMsg(7, -1, locs)
+    segs = msg.to_segments(4096)
+    assert len(segs) == 1
+    parsed = RpcMsg.parse_segment(segs[0])
+    assert parsed.is_last and parsed.shuffle_id == 7 and parsed.partition_id == -1
+    assert parsed.locations == locs
+
+
+def test_publish_multi_segment_accumulation():
+    locs = [
+        PartitionLocation(MID, i % 13, BlockLocation(i * 4096, 4096, i))
+        for i in range(500)
+    ]
+    msg = PublishPartitionLocationsMsg(42, 3, locs)
+    seg_size = 512
+    segs = msg.to_segments(seg_size)
+    assert len(segs) > 1
+    assert all(len(s) <= seg_size for s in segs)
+    acc = []
+    last_seen = 0
+    for s in segs:
+        parsed = RpcMsg.parse_segment(s)
+        assert parsed.shuffle_id == 42 and parsed.partition_id == 3
+        acc.extend(parsed.locations)
+        if parsed.is_last:
+            last_seen += 1
+    assert last_seen == 1
+    assert RpcMsg.parse_segment(segs[-1]).is_last
+    assert acc == locs
+
+
+def test_announce_multi_segment():
+    mids = [ShuffleManagerId(f"host-{i}", 1000 + i, f"exec-{i}") for i in range(100)]
+    msg = AnnounceManagersMsg(mids)
+    segs = msg.to_segments(256)
+    assert len(segs) > 1
+    acc = []
+    for s in segs:
+        parsed = RpcMsg.parse_segment(s)
+        acc.extend(parsed.manager_ids)
+    assert acc == mids
+    assert RpcMsg.parse_segment(segs[-1]).is_last
+    assert not RpcMsg.parse_segment(segs[0]).is_last
