@@ -1,0 +1,10 @@
+from sparkrdma_tpu.engine.serializer import PickleSerializer, Serializer
+
+
+def __getattr__(name):
+    # lazy to avoid a circular import with shuffle.handle
+    if name == "TpuContext":
+        from sparkrdma_tpu.engine.context import TpuContext
+
+        return TpuContext
+    raise AttributeError(name)

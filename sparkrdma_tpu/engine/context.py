@@ -1,0 +1,202 @@
+"""TpuContext — driver + executors + stage scheduler.
+
+The Spark-role host: owns one driver TpuShuffleManager (metadata hub)
+and N executor managers (each a full transport endpoint, as in the
+reference's process topology — SURVEY.md §1 "Process topology"), cuts
+the RDD lineage at shuffle dependencies, runs map stages with a
+barrier, and recomputes stages on fetch failure (the reference
+delegates recompute to Spark via FetchFailedException;
+RdmaShuffleFetcherIterator.scala:381-391).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from sparkrdma_tpu.engine.rdd import (
+    GeneratorRDD,
+    ParallelCollectionRDD,
+    RDD,
+    ShuffledRDD,
+)
+from sparkrdma_tpu.shuffle.errors import ShuffleError
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+logger = logging.getLogger(__name__)
+
+
+class TpuContext:
+    def __init__(
+        self,
+        num_executors: int = 2,
+        conf: Optional[TpuShuffleConf] = None,
+        task_threads: int = 4,
+    ):
+        self.conf = conf or TpuShuffleConf()
+        self.driver = TpuShuffleManager(self.conf, is_driver=True)
+        self.executors: List[TpuShuffleManager] = [
+            TpuShuffleManager(self.conf, is_driver=False, executor_id=f"exec-{i}")
+            for i in range(num_executors)
+        ]
+        self._pool = ThreadPoolExecutor(max_workers=task_threads)
+        self._id_lock = threading.Lock()
+        self._rdd_counter = 0
+        self._shuffle_counter = 0
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+    def _next_rdd_id(self) -> int:
+        with self._id_lock:
+            self._rdd_counter += 1
+            return self._rdd_counter
+
+    def _next_shuffle_id(self) -> int:
+        with self._id_lock:
+            self._shuffle_counter += 1
+            return self._shuffle_counter
+
+    def executor_for_partition(self, partition: int) -> TpuShuffleManager:
+        return self.executors[partition % len(self.executors)]
+
+    # ------------------------------------------------------------------
+    def parallelize(self, data, num_partitions: int = None) -> RDD:
+        n = num_partitions or len(self.executors)
+        return ParallelCollectionRDD(self, list(data), n)
+
+    def generate(self, gen, num_partitions: int) -> RDD:
+        return GeneratorRDD(self, gen, num_partitions)
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+    def ensure_parents(self, rdd: RDD) -> None:
+        """Materialize every un-materialized shuffle below rdd."""
+        for dep in self._shuffle_deps(rdd):
+            if dep.handle is None:
+                self._run_map_stage(dep)
+
+    def _shuffle_deps(self, rdd: RDD) -> List[ShuffledRDD]:
+        """Direct shuffle dependencies (stage boundary cut)."""
+        out: List[ShuffledRDD] = []
+        seen = set()
+
+        def walk(r: RDD) -> None:
+            if id(r) in seen:
+                return
+            seen.add(id(r))
+            if isinstance(r, ShuffledRDD):
+                out.append(r)
+                return  # deeper deps handled when r's map stage runs
+            for attr in ("parent", "a", "b"):
+                child = getattr(r, attr, None)
+                if isinstance(child, RDD):
+                    walk(child)
+
+        walk(rdd)
+        return out
+
+    def _run_map_stage(self, dep: ShuffledRDD, attempts: int = 2) -> None:
+        """Run the parent stage of a shuffle with a completion barrier.
+
+        Transient map-task failures retry the whole stage under a fresh
+        shuffle id (the failed id is unregistered so its deferred-fetch
+        state doesn't linger on the driver).
+        """
+        parent = dep.parent
+        self.ensure_parents(parent)  # recursive stage materialization
+
+        last_error: Optional[Exception] = None
+        for attempt in range(attempts):
+            shuffle_id = self._next_shuffle_id()
+            handle = BaseShuffleHandle(
+                shuffle_id=shuffle_id,
+                num_maps=parent.num_partitions,
+                partitioner=dep.partitioner,
+                aggregator=dep.aggregator,
+                map_side_combine=dep.map_side_combine,
+                key_ordering=dep.key_ordering,
+            )
+            self.driver.register_shuffle(handle)
+
+            def run_map(map_id: int) -> None:
+                executor = self.executor_for_partition(map_id)
+                writer = executor.get_writer(handle, map_id)
+                try:
+                    writer.write(parent.compute(map_id))
+                    writer.stop(True)
+                except Exception:
+                    writer.stop(False)
+                    raise
+
+            futures = [
+                self._pool.submit(run_map, m) for m in range(parent.num_partitions)
+            ]
+            errors = [f.exception() for f in futures if f.exception() is not None]
+            if not errors:
+                for executor in self.executors:
+                    executor.finalize_maps(shuffle_id)
+                dep.handle = handle
+                return
+            last_error = errors[0]
+            logger.warning(
+                "map stage for shuffle %d failed (attempt %d/%d): %s",
+                shuffle_id,
+                attempt + 1,
+                attempts,
+                last_error,
+            )
+            self.driver.unregister_shuffle(shuffle_id)
+            for executor in self.executors:
+                executor.unregister_shuffle(shuffle_id)
+        assert last_error is not None
+        raise last_error
+
+    def run_job(self, rdd: RDD) -> List:
+        """Compute all partitions of rdd; recompute stages on fetch failure."""
+        for attempt in range(2):
+            try:
+                self.ensure_parents(rdd)
+                futures = [
+                    self._pool.submit(lambda p=p: list(rdd.compute(p)))
+                    for p in range(rdd.num_partitions)
+                ]
+                out: List = []
+                errors = []
+                for f in futures:
+                    e = f.exception()
+                    if e is not None:
+                        errors.append(e)
+                    else:
+                        out.extend(f.result())
+                if not errors:
+                    return out
+                raise errors[0]
+            except ShuffleError as e:
+                if attempt == 1:
+                    raise
+                logger.warning("fetch failed (%s); recomputing stages", e)
+                # invalidate materialized shuffles below rdd and retry
+                for dep in self._shuffle_deps(rdd):
+                    dep.handle = None
+        raise RuntimeError("unreachable")
+
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self._pool.shutdown(wait=True)
+        for executor in self.executors:
+            executor.stop()
+        self.driver.stop()
+
+    def __enter__(self) -> "TpuContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,134 @@
+"""Record serialization + stream compression, applied symmetrically.
+
+The reference delegates both to Spark (serializerManager.wrapStream on
+read, the serializer instance inside the sort writer) and applies them
+symmetrically on write and read (SURVEY.md §5.1 #8; reflected
+wrapStream at RdmaShuffleReader.scala:116-126). Here the same contract:
+a :class:`Serializer` turns an iterator of (key, value) records into a
+byte stream and back, and an optional zlib compression codec wraps both
+sides.
+
+Wire format per record: 4-byte length + pickled (k, v) tuple. A zero
+length marks end-of-stream (so concatenated partition segments from
+different map outputs can be framed independently and read back to
+exhaustion of the underlying stream).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import zlib
+from typing import BinaryIO, Iterator, Tuple
+
+_LEN = struct.Struct(">I")
+
+
+class Serializer:
+    name = "base"
+
+    def dump_stream(self, records: Iterator[Tuple], out: BinaryIO) -> None:
+        raise NotImplementedError
+
+    def load_stream(self, inp: BinaryIO) -> Iterator[Tuple]:
+        raise NotImplementedError
+
+
+class PickleSerializer(Serializer):
+    name = "pickle"
+
+    def dump_stream(self, records, out: BinaryIO) -> None:
+        pack = _LEN.pack
+        dumps = pickle.dumps
+        for rec in records:
+            data = dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+            out.write(pack(len(data)))
+            out.write(data)
+
+    def load_stream(self, inp: BinaryIO):
+        unpack = _LEN.unpack
+        loads = pickle.loads
+        read = inp.read
+        while True:
+            header = read(4)
+            if len(header) < 4:
+                return
+            (n,) = unpack(header)
+            if n == 0:
+                return
+            data = read(n)
+            if len(data) < n:
+                raise EOFError("truncated record stream")
+            yield loads(data)
+
+
+class CompressionCodec:
+    """zlib stream codec (Spark's lz4 role). Level 1: shuffle wants speed."""
+
+    def __init__(self, enabled: bool = True, level: int = 1):
+        self.enabled = enabled
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        if not self.enabled:
+            return data
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes) -> bytes:
+        if not self.enabled:
+            return data
+        return zlib.decompress(data)
+
+
+def frame_compressed(codec: CompressionCodec, raw: bytes) -> bytes:
+    """Compress one block and length-prefix it — THE wire frame format."""
+    block = codec.compress(raw)
+    return _LEN.pack(len(block)) + block
+
+
+class CompressedBlockWriter:
+    """Accumulates serialized bytes, emits one compressed block on flush.
+
+    Write side of the symmetric contract: each map task's bytes for one
+    partition become one length-prefixed compressed block, so the read
+    side can frame blocks from many map outputs concatenated back to
+    back.
+    """
+
+    def __init__(self, codec: CompressionCodec, sink):
+        self._codec = codec
+        self._sink = sink  # callable(bytes) → None
+        self._buf = bytearray()
+
+    def write(self, data: bytes) -> int:
+        self._buf.extend(data)
+        return len(data)
+
+    @property
+    def pending(self) -> int:
+        """Bytes accumulated since the last flush_block."""
+        return len(self._buf)
+
+    def flush_block(self) -> int:
+        """Compress and emit the accumulated block; returns emitted size."""
+        if not self._buf:
+            return 0
+        framed = frame_compressed(self._codec, bytes(self._buf))
+        self._sink(framed)
+        self._buf.clear()
+        return len(framed)
+
+
+def iter_compressed_blocks(inp: BinaryIO, codec: CompressionCodec) -> Iterator[bytes]:
+    """Read side: yield decompressed blocks until the stream is exhausted."""
+    while True:
+        header = inp.read(4)
+        if len(header) < 4:
+            return
+        (n,) = _LEN.unpack(header)
+        if n == 0:
+            return
+        block = inp.read(n)
+        if len(block) < n:
+            raise EOFError("truncated compressed block")
+        yield codec.decompress(block)

@@ -7,12 +7,16 @@ allocates off-JVM-heap memory with ``sun.misc.Unsafe.allocateMemory``
 LOCAL_WRITE|REMOTE_WRITE|REMOTE_READ access (:81-88), and wraps the raw
 address as a DirectByteBuffer (:114-136).
 
-Here the allocation comes from the native C++ arena when available
-(sparkrdma_tpu.native — page-aligned malloc outside the Python heap) and
-falls back to an anonymous ``mmap`` (also page-aligned, outside the
-Python object heap). Registration inserts the region into the endpoint's
-:class:`~sparkrdma_tpu.memory.registry.ProtectionDomain`, yielding the
-``mkey`` used by remote one-sided READs.
+Here the allocation is an anonymous ``mmap`` (page-aligned, outside the
+Python object heap) by default: ``mmap.close()`` refuses to free while
+exported sub-views (open streams) exist, which makes ``free()``
+leak-safe instead of use-after-free under still-open readers. The
+native C++ arena (sparkrdma_tpu.native) backs allocations whose
+lifetime the framework fully controls (``arena=True`` — staging copies,
+bench buffers); its ``free()`` is unconditional, so it must never be
+handed to consumer-owned streams. Registration inserts the region into
+the endpoint's :class:`~sparkrdma_tpu.memory.registry.ProtectionDomain`,
+yielding the ``mkey`` used by remote one-sided READs.
 """
 
 from __future__ import annotations
@@ -27,13 +31,19 @@ from sparkrdma_tpu.native.arena import NativeArena, native_arena_available
 class TpuBuffer:
     """A single allocation with optional PD registration."""
 
-    def __init__(self, pd: Optional[ProtectionDomain], length: int, register: bool = True):
+    def __init__(
+        self,
+        pd: Optional[ProtectionDomain],
+        length: int,
+        register: bool = True,
+        arena: bool = False,
+    ):
         if length <= 0:
             raise ValueError(f"buffer length must be positive, got {length}")
         self.length = length
         self._arena: Optional[NativeArena] = None
         self._mmap: Optional[mmap.mmap] = None
-        if native_arena_available():
+        if arena and native_arena_available():
             self._arena = NativeArena.shared()
             self._alloc_id, view = self._arena.alloc(length)
         else:
@@ -89,10 +99,17 @@ class TpuBuffer:
         if view is not None:
             view.release()
         if self._arena is not None:
+            # arena memory is framework-owned; no consumer views may
+            # outlive it (see class docstring), so the free is immediate
             self._arena.free(self._alloc_id)
             self._arena = None
         if self._mmap is not None:
-            self._mmap.close()
+            try:
+                self._mmap.close()
+            except BufferError:
+                # live sub-views (unclosed streams): the mapping stays
+                # until they die — leak-safe, never use-after-free
+                pass
             self._mmap = None
 
     def __len__(self) -> int:

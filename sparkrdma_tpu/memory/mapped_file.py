@@ -153,8 +153,13 @@ class MappedFile:
         self._disposed = True
         for m in self._mappings:
             self._pd.deregister(m.mkey)
-            m.view.release()
-            m.mm.close()
+            try:
+                m.view.release()
+                m.mm.close()
+            except BufferError:
+                # a partition view from a still-open stream keeps the
+                # mapping alive; the OS unmaps when the last view dies
+                pass
         self._mappings.clear()
         os.close(self._fd)
         try:

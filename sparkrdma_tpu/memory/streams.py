@@ -15,7 +15,7 @@ from typing import Optional
 
 class MemoryviewInputStream(io.RawIOBase):
     def __init__(self, view: memoryview, on_close=None):
-        self._view = view
+        self._view: Optional[memoryview] = view
         self._pos = 0
         self._on_close = on_close
 
@@ -23,6 +23,8 @@ class MemoryviewInputStream(io.RawIOBase):
         return True
 
     def readinto(self, b) -> int:
+        if self._view is None:
+            raise ValueError("read on closed stream")
         n = min(len(b), len(self._view) - self._pos)
         if n <= 0:
             return 0
@@ -31,6 +33,8 @@ class MemoryviewInputStream(io.RawIOBase):
         return n
 
     def read(self, size: int = -1) -> bytes:
+        if self._view is None:
+            raise ValueError("read on closed stream")
         if size is None or size < 0:
             size = len(self._view) - self._pos
         n = min(size, len(self._view) - self._pos)
@@ -39,6 +43,11 @@ class MemoryviewInputStream(io.RawIOBase):
         return out
 
     def close(self) -> None:
+        # release the exported view eagerly so the owning buffer/mapping
+        # can be freed deterministically at dispose time
+        view, self._view = self._view, None
+        if view is not None:
+            view.release()
         if not self.closed and self._on_close is not None:
             cb, self._on_close = self._on_close, None
             cb()

@@ -94,8 +94,15 @@ class PublishPartitionLocationsMsg(RpcMsg):
     partition_id: int  # -1 = writer publish; else the fetched partition
     locations: List[PartitionLocation] = field(default_factory=list)
     is_last: bool = True
+    # writer→driver publishes carry how many map outputs this message
+    # completes so the driver can act as the map-output tracker and
+    # defer fetch replies until the shuffle is complete (the reference
+    # relies on Spark's own MapOutputTracker for this barrier; here the
+    # control plane owns it). 0 on driver→reducer replies.
+    num_map_outputs: int = 0
 
-    _HDR = struct.Struct(">Bii")  # is_last(1) shuffle_id(4) partition_id(4)
+    # is_last(1) shuffle_id(4) partition_id(4) num_map_outputs(4)
+    _HDR = struct.Struct(">Biii")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         budget = seg_size - SEG_HEADER.size - self._HDR.size
@@ -118,7 +125,14 @@ class PublishPartitionLocationsMsg(RpcMsg):
         for i, group in enumerate(groups):
             is_last = i == len(groups) - 1
             buf = BytesIO()
-            buf.write(self._HDR.pack(1 if is_last else 0, self.shuffle_id, self.partition_id))
+            buf.write(
+                self._HDR.pack(
+                    1 if is_last else 0,
+                    self.shuffle_id,
+                    self.partition_id,
+                    self.num_map_outputs,
+                )
+            )
             for loc in group:
                 loc.write(buf)
             segments.append(self.frame(self.msg_type, buf.getvalue()))
@@ -127,12 +141,14 @@ class PublishPartitionLocationsMsg(RpcMsg):
     @classmethod
     def from_payload(cls, payload: bytes) -> "PublishPartitionLocationsMsg":
         inp = BytesIO(payload)
-        is_last, shuffle_id, partition_id = cls._HDR.unpack(inp.read(cls._HDR.size))
+        is_last, shuffle_id, partition_id, num_maps = cls._HDR.unpack(
+            inp.read(cls._HDR.size)
+        )
         locs = []
         end = len(payload)
         while inp.tell() < end:
             locs.append(PartitionLocation.read(inp))
-        return cls(shuffle_id, partition_id, locs, bool(is_last))
+        return cls(shuffle_id, partition_id, locs, bool(is_last), num_maps)
 
 
 @dataclass

@@ -1,0 +1,4 @@
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+__all__ = ["BaseShuffleHandle", "TpuShuffleManager"]

@@ -1,0 +1,45 @@
+"""Failure types surfaced to the scheduler for recompute.
+
+Analogues of Spark's FetchFailedException / MetadataFetchFailedException
+as the reference raises them (RdmaShuffleFetcherIterator.scala:381-391,
+226-237): failures never hang the iterator — they surface so the
+scheduler can re-run the producing stage (SURVEY.md §5.1 #9).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sparkrdma_tpu.locations import ShuffleManagerId
+
+
+class ShuffleError(Exception):
+    pass
+
+
+class FetchFailedError(ShuffleError):
+    def __init__(
+        self,
+        manager_id: Optional[ShuffleManagerId],
+        shuffle_id: int,
+        map_id: int,
+        partition_id: int,
+        message: str,
+    ):
+        self.manager_id = manager_id
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.partition_id = partition_id
+        super().__init__(
+            f"fetch failed: shuffle {shuffle_id} partition {partition_id} "
+            f"from {manager_id}: {message}"
+        )
+
+
+class MetadataFetchFailedError(ShuffleError):
+    def __init__(self, shuffle_id: int, partition_id: int, message: str):
+        self.shuffle_id = shuffle_id
+        self.partition_id = partition_id
+        super().__init__(
+            f"metadata fetch failed: shuffle {shuffle_id} partition {partition_id}: {message}"
+        )

@@ -1,0 +1,298 @@
+"""TpuShuffleFetcherIterator — the read-path engine.
+
+Analogue of RdmaShuffleFetcherIterator.scala (reference: /root/
+reference/src/main/scala/org/apache/spark/shuffle/rdma/
+RdmaShuffleFetcherIterator.scala). Semantics preserved:
+
+- async location fetch from the driver for ``[start, end)`` with a
+  timeout wrapper (:108-122, 220-320),
+- local partitions short-circuit to streams, never looping through the
+  network (:328-339; SURVEY.md §5.1 #2),
+- remote blocks are grouped **per source manager** into
+  ``AggregatedPartitionGroup``s capped at ``shuffle_read_block_size``
+  (:252-275),
+- one one-sided READ per group pulls all its blocks into one pooled
+  registered buffer, sliced per block (:132-218),
+- ``max_bytes_in_flight`` throttle with a pending-fetch queue drained
+  as results are consumed (:279-284, 369-379),
+- the blocking results queue carries Success/Failure/FailureMetadata
+  and a sentinel "+1 block" protocol keeps ``has_next`` truthful until
+  all fetches are enqueued (:47-50, 124-130, 288, 434-448),
+- failures surface as FetchFailedError / MetadataFetchFailedError so
+  the scheduler can recompute; one failed block fails the whole reduce
+  task by design (:203, 381-391),
+- streams release their registered buffer slice on close
+  (BufferReleasingInputStream, :399-429),
+- per-fetch latency histogram hook (:186-189).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleManagerId
+from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
+from sparkrdma_tpu.memory.streams import MemoryviewInputStream
+from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
+from sparkrdma_tpu.transport import FnListener
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ShuffleMetrics:
+    """TaskMetrics stand-in (reference Spark metrics integration)."""
+
+    local_blocks: int = 0
+    remote_blocks: int = 0
+    local_bytes: int = 0
+    remote_bytes: int = 0
+    fetch_wait_ms: float = 0.0
+    records_read: int = 0
+
+
+@dataclass
+class AggregatedPartitionGroup:
+    """Blocks from one source manager read in one one-sided READ (:71-74)."""
+
+    total_length: int = 0
+    blocks: List[Tuple[int, BlockLocation]] = field(default_factory=list)  # (pid, loc)
+
+
+@dataclass
+class _Success:
+    streams: List[Tuple[int, BinaryIO]]  # (partition_id, stream)
+    in_flight: int = 0
+
+
+@dataclass
+class _Failure:
+    manager_id: Optional[ShuffleManagerId]
+    partition_id: int
+    error: Exception
+    in_flight: int = 0
+
+
+class _Dummy:
+    in_flight = 0
+
+
+@dataclass
+class _PendingFetch:
+    manager_id: ShuffleManagerId
+    group: AggregatedPartitionGroup
+
+
+class TpuShuffleFetcherIterator:
+    """Iterator of (partition_id, stream) over local + remote blocks."""
+
+    def __init__(self, manager, handle, start_partition: int, end_partition: int):
+        self._manager = manager
+        self._handle = handle
+        self.start_partition = start_partition
+        self.end_partition = end_partition
+        self.metrics = ShuffleMetrics()
+
+        self._results: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        # sentinel "+1": keeps has_next true until enumeration completes
+        self._total_results = 1
+        self._processed_results = 0
+        self._bytes_in_flight = 0
+        self._pending: List[_PendingFetch] = []
+        self._buffered: List[Tuple[int, BinaryIO]] = []
+
+        self._start()
+
+    # ------------------------------------------------------------------
+    def _start(self) -> None:
+        # local partitions short-circuit (:328-339)
+        resolver = self._manager.resolver
+        local_streams: List[Tuple[int, BinaryIO]] = []
+        for pid in range(self.start_partition, self.end_partition):
+            for stream in resolver.get_local_partition_streams(
+                self._handle.shuffle_id, pid
+            ):
+                local_streams.append((pid, stream))
+                self.metrics.local_blocks += 1
+        if local_streams:
+            with self._lock:
+                self._total_results += 1
+            self._results.put(_Success(local_streams))
+
+        threading.Thread(
+            target=self._resolve_and_fetch, name="fetcher-locations", daemon=True
+        ).start()
+
+    def _resolve_and_fetch(self) -> None:
+        """Async location resolution + group construction (:220-320)."""
+        t0 = time.monotonic()
+        future = self._manager.fetch_remote_partition_locations(
+            self._handle.shuffle_id, self.start_partition, self.end_partition
+        )
+        try:
+            locations: List[PartitionLocation] = future.result(
+                timeout=self._manager.conf.fetch_location_timeout_ms / 1000.0
+            )
+        except Exception as e:
+            self._results.put(
+                _Failure(
+                    None,
+                    self.start_partition,
+                    MetadataFetchFailedError(
+                        self._handle.shuffle_id, self.start_partition, str(e)
+                    ),
+                )
+            )
+            return
+        logger.debug(
+            "fetched %d locations in %.1f ms",
+            len(locations),
+            (time.monotonic() - t0) * 1e3,
+        )
+
+        my_id = self._manager.executor_id
+        by_manager: Dict[ShuffleManagerId, List[Tuple[int, BlockLocation]]] = {}
+        for loc in locations:
+            if loc.manager_id.executor_id == my_id:
+                continue  # already served locally
+            by_manager.setdefault(loc.manager_id, []).append((loc.partition_id, loc.block))
+
+        # pack per-manager groups ≤ read_block_size (:252-275)
+        read_block_size = self._manager.conf.shuffle_read_block_size
+        fetches: List[_PendingFetch] = []
+        for mid, blocks in by_manager.items():
+            group = AggregatedPartitionGroup()
+            for pid, block in blocks:
+                if group.blocks and group.total_length + block.length > read_block_size:
+                    fetches.append(_PendingFetch(mid, group))
+                    group = AggregatedPartitionGroup()
+                group.blocks.append((pid, block))
+                group.total_length += block.length
+            if group.blocks:
+                fetches.append(_PendingFetch(mid, group))
+
+        max_in_flight = self._manager.conf.max_bytes_in_flight
+        start_now: List[_PendingFetch] = []
+        with self._lock:
+            self._total_results += len(fetches)
+            for fetch in fetches:
+                if self._bytes_in_flight < max_in_flight:
+                    self._bytes_in_flight += fetch.group.total_length
+                    start_now.append(fetch)
+                else:
+                    self._pending.append(fetch)
+        # resolve the sentinel now that enumeration is complete (:124-130)
+        self._results.put(_Dummy())
+        for fetch in start_now:
+            self._fetch_blocks(fetch)
+
+    def _fetch_blocks(self, fetch: _PendingFetch) -> None:
+        """Issue one one-sided READ for a whole group (:132-218)."""
+        mid, group = fetch.manager_id, fetch.group
+        t0 = time.monotonic()
+        try:
+            channel = self._manager.get_channel_to(mid)
+            reg = RegisteredBuffer(self._manager.buffer_manager, group.total_length)
+            # each slice holds one refcount; buffer returns to the pool
+            # when the last stream closes (:399-429)
+            slices = [reg.slice(block.length) for _, block in group.blocks]
+        except Exception as e:
+            self._results.put(
+                _Failure(mid, group.blocks[0][0], e, in_flight=group.total_length)
+            )
+            return
+
+        def on_success(_) -> None:
+            stats = self._manager.reader_stats
+            if stats is not None:
+                stats.update_remote_fetch_histogram(mid, (time.monotonic() - t0) * 1e3)
+            streams: List[Tuple[int, BinaryIO]] = []
+            for (pid, _block), sl in zip(group.blocks, slices):
+                streams.append(
+                    (pid, MemoryviewInputStream(sl.view, on_close=sl.release))
+                )
+            self.metrics.remote_blocks += len(streams)
+            self.metrics.remote_bytes += group.total_length
+            self._results.put(_Success(streams, in_flight=group.total_length))
+
+        failed_once = threading.Event()
+
+        def on_failure(e: Exception) -> None:
+            if failed_once.is_set():
+                return  # on_failure may legally fire more than once
+            failed_once.set()
+            for sl in slices:
+                sl.release()
+            self._results.put(
+                _Failure(
+                    mid,
+                    group.blocks[0][0],
+                    e,
+                    in_flight=group.total_length,
+                )
+            )
+
+        channel.read_in_queue(
+            FnListener(on_success, on_failure),
+            [sl.view for sl in slices],
+            [(block.mkey, block.address, block.length) for _, block in group.blocks],
+        )
+
+    # ------------------------------------------------------------------
+    def _drain_pending(self) -> None:
+        """Start queued fetches now under the in-flight cap (:369-379)."""
+        max_in_flight = self._manager.conf.max_bytes_in_flight
+        start_now: List[_PendingFetch] = []
+        with self._lock:
+            while self._pending and self._bytes_in_flight < max_in_flight:
+                fetch = self._pending.pop(0)
+                self._bytes_in_flight += fetch.group.total_length
+                start_now.append(fetch)
+        for fetch in start_now:
+            self._fetch_blocks(fetch)
+
+    def has_next(self) -> bool:
+        if self._buffered:
+            return True
+        with self._lock:
+            return self._processed_results < self._total_results
+
+    def next(self) -> Tuple[int, BinaryIO]:
+        while not self._buffered:
+            if not self.has_next():
+                raise StopIteration
+            t0 = time.monotonic()
+            result = self._results.get()
+            self.metrics.fetch_wait_ms += (time.monotonic() - t0) * 1e3
+            with self._lock:
+                self._processed_results += 1
+                self._bytes_in_flight -= result.in_flight
+            self._drain_pending()
+            if isinstance(result, _Failure):
+                err = result.error
+                if isinstance(err, (FetchFailedError, MetadataFetchFailedError)):
+                    raise err
+                raise FetchFailedError(
+                    result.manager_id,
+                    self._handle.shuffle_id,
+                    -1,
+                    result.partition_id,
+                    str(err),
+                )
+            if isinstance(result, _Success):
+                self._buffered.extend(result.streams)
+        return self._buffered.pop(0)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()

@@ -1,0 +1,64 @@
+"""TpuShuffleReader — records out of fetched partition streams.
+
+Analogue of RdmaShuffleReader.scala (reference: /root/reference/src/
+main/scala/org/apache/spark/shuffle/rdma/RdmaShuffleReader.scala):
+wraps the fetcher iterator's streams with the symmetric decompression +
+deserialization (:52-67), merges metrics, applies the aggregator
+(map-side-combine aware, :81-96) and optional key ordering (:99-112 —
+the ExternalSorter role).
+"""
+
+from __future__ import annotations
+
+from io import BytesIO
+from typing import Iterator, Tuple
+
+from sparkrdma_tpu.engine.serializer import PickleSerializer, iter_compressed_blocks
+from sparkrdma_tpu.shuffle.fetcher import TpuShuffleFetcherIterator
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, combine_by_key
+
+
+class TpuShuffleReader:
+    def __init__(
+        self,
+        manager,
+        handle: BaseShuffleHandle,
+        start_partition: int,
+        end_partition: int,
+    ):
+        self._manager = manager
+        self._handle = handle
+        self._fetcher = TpuShuffleFetcherIterator(
+            manager, handle, start_partition, end_partition
+        )
+        self._serializer = PickleSerializer()
+
+    @property
+    def metrics(self):
+        return self._fetcher.metrics
+
+    def _record_iter(self) -> Iterator[Tuple]:
+        codec = self._manager.resolver.codec
+        metrics = self._fetcher.metrics
+        for _pid, stream in self._fetcher:
+            try:
+                for block in iter_compressed_blocks(stream, codec):
+                    for rec in self._serializer.load_stream(BytesIO(block)):
+                        metrics.records_read += 1
+                        yield rec
+            finally:
+                stream.close()
+
+    def read(self) -> Iterator[Tuple]:
+        """Iterator of (key, value) with aggregation/ordering applied."""
+        records = self._record_iter()
+        agg = self._handle.aggregator
+        if agg is not None:
+            # with map-side combine the incoming values are combiners (:87-90)
+            combined = combine_by_key(
+                records, agg, values_are_combiners=self._handle.map_side_combine
+            )
+            records = iter(combined.items())
+        if self._handle.key_ordering:
+            records = iter(sorted(records, key=lambda kv: kv[0]))
+        return records

@@ -1,0 +1,208 @@
+"""ChunkedPartitionAgg writer method: serialize straight into registered
+chunks, aggregated per partition across all map tasks of an executor.
+
+Analogue of chunkedpartitionagg/RdmaChunkedPartitionAggShuffleWriter.scala
+(reference: /root/reference/src/main/scala/org/apache/spark/shuffle/
+rdma/writer/chunkedpartitionagg/). Semantics preserved:
+
+- per-partition stream stacks: serializer → compressor → chunked
+  scratch buffers (:114-130), flushed into the shared per-partition
+  :class:`PartitionWriter` once ``shuffle_write_flush_size`` bytes
+  accumulate, with chunk recycling (:154-191),
+- all map tasks of one executor append into the same partition logs,
+  so the executor publishes **one aggregated location set** instead of
+  one per map task (:45-73),
+- publication happens at the map-stage barrier via
+  ``finalize_and_publish`` (driven by the engine / manager), replacing
+  the reference's fragile "last active writer publishes" trigger — and
+  per-map partition lengths are tracked accurately, fixing the known
+  wrong-MapStatus-lengths quirk (reference TODO at :217-218;
+  SURVEY.md §5.1 "known quirks").
+
+Trade-off vs Wrapper (as in the reference): no per-map data removal —
+aggregated logs mix map outputs, so a failed map task invalidates the
+whole shuffle's data on this executor (remove_data_by_map degrades to
+dispose-on-failure).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import struct
+import threading
+from typing import BinaryIO, Dict, List, Optional, Sequence
+
+from sparkrdma_tpu.engine.serializer import frame_compressed
+from sparkrdma_tpu.locations import PartitionLocation
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, combine_by_key
+from sparkrdma_tpu.shuffle.writer import ShuffleData
+from sparkrdma_tpu.shuffle.writer.chunked_buffer import ChunkedByteBufferOutputStream
+from sparkrdma_tpu.shuffle.writer.partition_writer import PartitionWriter
+from sparkrdma_tpu.shuffle.writer.wrapper import MapStatus
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+
+
+class ChunkedAggShuffleData(ShuffleData):
+    def __init__(self, resolver, shuffle_id: int, num_partitions: int):
+        self._resolver = resolver
+        self.shuffle_id = shuffle_id
+        self.num_partitions = num_partitions
+        self._writers: Dict[int, PartitionWriter] = {}
+        self._lock = threading.Lock()
+        self._active_shuffle_writers = 0
+        self._committed_maps = 0
+        self._published = False
+
+    def partition_writer(self, pid: int) -> PartitionWriter:
+        with self._lock:
+            pw = self._writers.get(pid)
+            if pw is None:
+                pw = PartitionWriter(
+                    self._resolver,
+                    self.shuffle_id,
+                    pid,
+                    self._resolver.conf.shuffle_write_block_size,
+                )
+                self._writers[pid] = pw
+            return pw
+
+    def new_shuffle_writer(self) -> None:
+        with self._lock:
+            self._active_shuffle_writers += 1
+
+    def commit_map_output(self) -> None:
+        """A map task finished successfully; counts toward the barrier."""
+        with self._lock:
+            self._active_shuffle_writers -= 1
+            self._committed_maps += 1
+
+    def abort_map_output(self) -> None:
+        """A map task failed: it must NOT count toward the driver's
+        map-output barrier (its stage will re-run)."""
+        with self._lock:
+            self._active_shuffle_writers -= 1
+
+    def finalize_and_publish(self, manager) -> None:
+        """Publish the aggregated location set once, at the map barrier.
+
+        Publishes even with zero locations (all-empty map outputs) so
+        the driver's map-output count completes.
+        """
+        with self._lock:
+            if self._published or self._committed_maps == 0:
+                return
+            if self._active_shuffle_writers > 0:
+                # engine called finalize before every writer stopped —
+                # publishing now would expose a partial location set
+                logger.warning(
+                    "finalize_and_publish with %d active writers on shuffle %d; deferring",
+                    self._active_shuffle_writers,
+                    self.shuffle_id,
+                )
+                return
+            self._published = True
+            writers = dict(self._writers)
+            committed = self._committed_maps
+        locs: List[PartitionLocation] = []
+        for pid, pw in writers.items():
+            for block_loc in pw.locations():
+                locs.append(PartitionLocation(manager.local_manager_id, pid, block_loc))
+        manager.publish_partition_locations(
+            self.shuffle_id, -1, locs, num_map_outputs=committed
+        )
+
+    def get_input_streams(self, partition_id: int) -> List[BinaryIO]:
+        with self._lock:
+            pw = self._writers.get(partition_id)
+        return pw.input_streams() if pw is not None else []
+
+    def write_index_file_and_commit(self, map_id, partition_lengths, data_tmp_path):
+        raise NotImplementedError("chunked-agg method does not use index files")
+
+    def remove_data_by_map(self, map_id: int) -> None:
+        # aggregated logs cannot excise one map's bytes; see module docstring
+        pass
+
+    def dispose(self) -> None:
+        with self._lock:
+            writers = list(self._writers.values())
+            self._writers.clear()
+        for pw in writers:
+            pw.dispose()
+
+
+class ChunkedAggShuffleWriter:
+    """One map task's writer serializing into the executor-shared logs."""
+
+    def __init__(self, manager, handle: BaseShuffleHandle, map_id: int):
+        self._manager = manager
+        self._handle = handle
+        self.map_id = map_id
+        self._data: ChunkedAggShuffleData = manager.resolver.get_or_create_shuffle_data(handle)
+        self._data.new_shuffle_writer()
+        self._conf = manager.conf
+        self._codec = manager.resolver.codec
+        self._streams: Dict[int, ChunkedByteBufferOutputStream] = {}
+        self._recycled: List = []
+        self._lengths = [0] * handle.num_partitions
+        self._stopped = False
+
+    def _stream(self, pid: int) -> ChunkedByteBufferOutputStream:
+        s = self._streams.get(pid)
+        if s is None:
+            s = ChunkedByteBufferOutputStream(
+                self._conf.shuffle_write_chunk_size, recycled=self._recycled
+            )
+            self._streams[pid] = s
+        return s
+
+    def _flush(self, pid: int) -> None:
+        """Compress the accumulated chunk data into the partition log."""
+        s = self._streams.pop(pid, None)
+        if s is None or s.length == 0:
+            return
+        cbb = s.to_chunked_byte_buffer()
+        raw = b"".join(bytes(v) for v in cbb.get_chunks())
+        # recycle chunk buffers for the next stream (:173-189)
+        for buf, _ in cbb.take_buffers():
+            self._recycled.append(buf)
+        framed = frame_compressed(self._codec, raw)
+        self._data.partition_writer(pid).append_frame(framed)
+        self._lengths[pid] += len(framed)
+
+    def write(self, records) -> None:
+        part = self._handle.partitioner.partition
+        flush_size = self._conf.shuffle_write_flush_size
+        if self._handle.aggregator is not None and self._handle.map_side_combine:
+            records = combine_by_key(records, self._handle.aggregator).items()
+        for rec in records:
+            data = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+            pid = part(rec[0])
+            s = self._stream(pid)
+            s.write(_LEN.pack(len(data)))
+            s.write(data)
+            if s.length >= flush_size:
+                self._flush(pid)
+
+    def stop(self, success: bool) -> Optional[MapStatus]:
+        if self._stopped:
+            return None
+        self._stopped = True
+        if success:
+            for pid in list(self._streams.keys()):
+                self._flush(pid)
+        for s in self._streams.values():
+            s.to_chunked_byte_buffer().dispose()
+        self._streams.clear()
+        for buf in self._recycled:
+            buf.free()
+        self._recycled.clear()
+        if success:
+            self._data.commit_map_output()
+            return MapStatus(self.map_id, self._lengths)
+        self._data.abort_map_output()
+        return None

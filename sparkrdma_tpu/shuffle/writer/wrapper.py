@@ -1,0 +1,133 @@
+"""Wrapper writer method (default): sort-shuffle file, mmap'd+registered.
+
+Analogue of wrapper/RdmaWrapperShuffleWriter.scala (reference: /root/
+reference/src/main/scala/org/apache/spark/shuffle/rdma/writer/wrapper/
+RdmaWrapperShuffleWriter.scala). Semantics preserved:
+
+- record writing is delegated to the sort-shuffle machinery
+  (:85-101 → sort_file.write_sorted_file here),
+- ``write_index_file_and_commit`` renames the tmp data file and
+  mmaps+registers it chunked by ``shuffle_write_block_size`` with
+  per-partition locations (:57-74),
+- on successful ``stop()`` the writer collects every **non-empty**
+  partition's location from the mapped file and publishes to the
+  driver with partition_id = -1 (:106-140; the driver re-keys each
+  location by its own partition id),
+- partitions are servable locally as streams (:40-44).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import BinaryIO, Dict, List, Optional, Sequence
+
+from sparkrdma_tpu.locations import PartitionLocation
+from sparkrdma_tpu.memory.mapped_file import MappedFile
+from sparkrdma_tpu.memory.streams import MemoryviewInputStream
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
+from sparkrdma_tpu.shuffle.writer import ShuffleData
+from sparkrdma_tpu.shuffle.writer.sort_file import write_sorted_file
+
+
+@dataclass
+class MapStatus:
+    map_id: int
+    partition_lengths: List[int]
+
+
+class WrapperShuffleData(ShuffleData):
+    def __init__(self, resolver, shuffle_id: int, num_partitions: int):
+        self._resolver = resolver
+        self.shuffle_id = shuffle_id
+        self.num_partitions = num_partitions
+        self._mapped: Dict[int, MappedFile] = {}
+        self._lock = threading.Lock()
+
+    def new_shuffle_writer(self) -> None:
+        pass  # no per-writer state for this method
+
+    def write_index_file_and_commit(
+        self, map_id: int, partition_lengths: Sequence[int], data_tmp_path: str
+    ) -> None:
+        final_path = self._resolver.data_file_path(self.shuffle_id, map_id)
+        os.replace(data_tmp_path, final_path)
+        mf = MappedFile(
+            final_path,
+            self._resolver.pd,
+            self._resolver.conf.shuffle_write_block_size,
+            list(partition_lengths),
+        )
+        with self._lock:
+            old = self._mapped.pop(map_id, None)
+            self._mapped[map_id] = mf
+        if old is not None:
+            old.dispose()  # speculative re-run replaced the output
+
+    def get_mapped_file(self, map_id: int) -> MappedFile:
+        with self._lock:
+            return self._mapped[map_id]
+
+    def get_input_streams(self, partition_id: int) -> List[BinaryIO]:
+        with self._lock:
+            files = list(self._mapped.values())
+        return [
+            MemoryviewInputStream(mf.get_partition_view(partition_id))
+            for mf in files
+            if mf.get_partition_location(partition_id).length > 0
+        ]
+
+    def remove_data_by_map(self, map_id: int) -> None:
+        with self._lock:
+            mf = self._mapped.pop(map_id, None)
+        if mf is not None:
+            mf.dispose()
+
+    def dispose(self) -> None:
+        with self._lock:
+            files = list(self._mapped.values())
+            self._mapped.clear()
+        for mf in files:
+            mf.dispose()
+
+
+class WrapperShuffleWriter:
+    """One map task's writer (reference :80-140)."""
+
+    def __init__(self, manager, handle: BaseShuffleHandle, map_id: int):
+        self._manager = manager
+        self._handle = handle
+        self.map_id = map_id
+        self._data: WrapperShuffleData = manager.resolver.get_or_create_shuffle_data(handle)
+        self._data.new_shuffle_writer()
+        self._lengths: Optional[List[int]] = None
+        self._stopped = False
+
+    def write(self, records) -> None:
+        resolver = self._manager.resolver
+        tmp = resolver.data_tmp_path(self._handle.shuffle_id, self.map_id)
+        lengths = write_sorted_file(records, self._handle, resolver.codec, tmp)
+        self._data.write_index_file_and_commit(self.map_id, lengths, tmp)
+        self._lengths = lengths
+
+    def stop(self, success: bool) -> Optional[MapStatus]:
+        if self._stopped:
+            return None
+        self._stopped = True
+        if not success or self._lengths is None:
+            self._data.remove_data_by_map(self.map_id)
+            return None
+        # collect non-empty partition locations and publish (:121-136);
+        # an all-empty map output still publishes so the driver's
+        # map-output count completes
+        mf = self._data.get_mapped_file(self.map_id)
+        locs = [
+            PartitionLocation(self._manager.local_manager_id, pid, mf.get_partition_location(pid))
+            for pid in range(self._handle.num_partitions)
+            if mf.get_partition_location(pid).length > 0
+        ]
+        self._manager.publish_partition_locations(
+            self._handle.shuffle_id, -1, locs, num_map_outputs=1
+        )
+        return MapStatus(self.map_id, self._lengths)

@@ -1,0 +1,5 @@
+from sparkrdma_tpu.transport.completion import CompletionListener, FnListener
+from sparkrdma_tpu.transport.channel import TpuChannel, ChannelError
+from sparkrdma_tpu.transport.node import TpuNode
+
+__all__ = ["CompletionListener", "FnListener", "TpuChannel", "ChannelError", "TpuNode"]

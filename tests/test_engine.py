@@ -1,0 +1,67 @@
+"""Engine-level workloads: golden-result jobs comparing the shuffle path
+to plain-Python computation (SURVEY.md §4 'workload-level truth')."""
+
+import random
+
+import pytest
+
+from sparkrdma_tpu.engine.context import TpuContext
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = TpuContext(num_executors=2)
+    yield c
+    c.stop()
+
+
+def test_wordcount(ctx):
+    words = [random.Random(7).choice("the quick brown fox jumps over lazy dog".split())
+             for _ in range(5000)]
+    rdd = ctx.parallelize(words, 4).map(lambda w: (w, 1)).reduce_by_key(lambda a, b: a + b)
+    got = dict(rdd.collect())
+    expected = {}
+    for w in words:
+        expected[w] = expected.get(w, 0) + 1
+    assert got == expected
+
+
+def test_sort_by_key_total_order(ctx):
+    rng = random.Random(13)
+    data = [(rng.randrange(10_000), i) for i in range(8000)]
+    rdd = ctx.parallelize(data, 4).sort_by_key(num_partitions=5)
+    out = rdd.collect()
+    keys = [k for k, _ in out]
+    assert keys == sorted(keys)
+    assert sorted(out) == sorted(data)
+
+
+def test_group_by_key(ctx):
+    data = [(i % 7, i) for i in range(700)]
+    got = dict(ctx.parallelize(data, 3).group_by_key(4).collect())
+    for k in range(7):
+        assert sorted(got[k]) == list(range(k, 700, 7))
+
+
+def test_join(ctx):
+    left = [(i % 5, f"l{i}") for i in range(20)]
+    right = [(i % 5, f"r{i}") for i in range(10)]
+    got = sorted(ctx.parallelize(left, 2).join(ctx.parallelize(right, 2)).collect())
+    expected = sorted(
+        (k, (lv, rv)) for k, lv in left for k2, rv in right if k == k2
+    )
+    assert got == expected
+
+
+def test_chained_shuffles(ctx):
+    # shuffle → narrow → shuffle (multi-stage lineage)
+    data = [(i % 10, 1) for i in range(1000)]
+    rdd = (
+        ctx.parallelize(data, 4)
+        .reduce_by_key(lambda a, b: a + b)
+        .map(lambda kv: (kv[1], kv[0]))
+        .sort_by_key(num_partitions=3)
+    )
+    out = rdd.collect()
+    assert [k for k, _ in out] == [100] * 10

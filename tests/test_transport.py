@@ -1,0 +1,175 @@
+"""Transport layer: SEND delivery, one-sided READ service, send-budget
+permit arithmetic under overflow (SURVEY.md §4 property target:
+RdmaChannel.java:589-625), error latching, stale-channel replacement."""
+
+import threading
+import time
+
+import pytest
+
+from sparkrdma_tpu.memory.buffer import TpuBuffer
+from sparkrdma_tpu.transport import FnListener, TpuNode
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+def _mk_node(executor_id, recv=None, conf=None):
+    return TpuNode(
+        conf or TpuShuffleConf(),
+        "127.0.0.1",
+        is_executor=True,
+        executor_id=executor_id,
+        recv_listener=recv,
+    )
+
+
+def test_send_delivery_and_read():
+    received = []
+    got = threading.Event()
+
+    def on_recv(ch, payload):
+        received.append(payload)
+        got.set()
+
+    a = _mk_node("exec-a")
+    b = _mk_node("exec-b", recv=on_recv)
+    try:
+        ch = a.get_channel("127.0.0.1", b.port)
+
+        # SEND: RPC segment delivery to b's recv listener
+        done = threading.Event()
+        ch.send_in_queue(FnListener(lambda _: done.set()), [b"hello-rpc"])
+        assert done.wait(5) and got.wait(5)
+        assert received == [b"hello-rpc"]
+
+        # one-sided READ: register a region on b, pull it from a
+        src = TpuBuffer(b.pd, 64 * 1024)
+        src.write(bytes(range(256)) * 256)
+        dst = TpuBuffer(a.pd, 64 * 1024, register=False)
+        read_done = threading.Event()
+        ch.read_in_queue(
+            FnListener(lambda _: read_done.set()),
+            [dst.view],
+            [(src.mkey, 0, 64 * 1024)],
+        )
+        assert read_done.wait(5)
+        assert dst.read() == src.read()
+        src.free()
+        dst.free()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_multi_block_read_scatter():
+    a = _mk_node("exec-a2")
+    b = _mk_node("exec-b2")
+    try:
+        ch = a.get_channel("127.0.0.1", b.port)
+        src = TpuBuffer(b.pd, 4096)
+        src.write(b"A" * 1000 + b"B" * 2000 + b"C" * 1096)
+        dst = TpuBuffer(a.pd, 4096, register=False)
+        done = threading.Event()
+        # three remote blocks, two destination views
+        ch.read_in_queue(
+            FnListener(lambda _: done.set()),
+            [dst.view[:1500], dst.view[1500:4096]],
+            [(src.mkey, 0, 1000), (src.mkey, 1000, 2000), (src.mkey, 3000, 1096)],
+        )
+        assert done.wait(5)
+        assert dst.read() == src.read()
+        src.free()
+        dst.free()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_read_unknown_mkey_fails_listener():
+    a = _mk_node("exec-a3")
+    b = _mk_node("exec-b3")
+    try:
+        ch = a.get_channel("127.0.0.1", b.port)
+        dst = TpuBuffer(a.pd, 1024, register=False)
+        failed = threading.Event()
+        errors = []
+        ch.read_in_queue(
+            FnListener(None, lambda e: (errors.append(e), failed.set())),
+            [dst.view[:100]],
+            [(999, 0, 100)],
+        )
+        assert failed.wait(5)
+        assert "not registered" in str(errors[0])
+        # channel survives a failed READ (no error latch)
+        assert ch.is_connected
+        dst.free()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_send_budget_overflow_drains():
+    conf = TpuShuffleConf({"tpu.shuffle.sendQueueDepth": "256"})
+    a = _mk_node("exec-a4", conf=conf)
+    b = _mk_node("exec-b4", conf=conf)
+    try:
+        ch = a.get_channel("127.0.0.1", b.port)
+        n = 600  # > sendQueueDepth permits in flight at once
+        done = [threading.Event() for _ in range(n)]
+        for i in range(n):
+            ch.send_in_queue(FnListener(lambda _, ev=done[i]: ev.set()), [b"x" * 100])
+        for ev in done:
+            assert ev.wait(5)
+        # all permits reclaimed after completions
+        assert ch._send_budget == conf.send_queue_depth
+        assert not ch._overflow
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_peer_loss_fails_outstanding_and_latches():
+    a = _mk_node("exec-a5")
+    b = _mk_node("exec-b5")
+    ch = a.get_channel("127.0.0.1", b.port)
+    failures = []
+    failed = threading.Event()
+    # stop b abruptly; subsequent posts must fail, not hang
+    b.stop()
+    time.sleep(0.1)
+    dst = TpuBuffer(a.pd, 1024, register=False)
+    ch.read_in_queue(
+        FnListener(None, lambda e: (failures.append(e), failed.set())),
+        [dst.view[:10]],
+        [(1, 0, 10)],
+    )
+    assert failed.wait(5)
+    assert not ch.is_connected
+    dst.free()
+    a.stop()
+
+
+def test_channel_cache_and_stale_replacement():
+    a = _mk_node("exec-a6")
+    b = _mk_node("exec-b6")
+    try:
+        ch1 = a.get_channel("127.0.0.1", b.port)
+        ch2 = a.get_channel("127.0.0.1", b.port)
+        assert ch1 is ch2  # cached
+        ch1.stop()
+        time.sleep(0.05)
+        ch3 = a.get_channel("127.0.0.1", b.port)
+        assert ch3 is not ch1  # dead channel replaced
+        assert ch3.is_connected
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_connect_refused_raises_after_attempts():
+    conf = TpuShuffleConf({"tpu.shuffle.maxConnectionAttempts": "2"})
+    a = _mk_node("exec-a7", conf=conf)
+    try:
+        with pytest.raises(IOError):
+            a.get_channel("127.0.0.1", 1)  # nothing listens on port 1
+    finally:
+        a.stop()
