@@ -1,0 +1,103 @@
+"""Benchmark: device TeraSort shuffle step vs the host sort baseline.
+
+The reference's only published number is HiBench TeraSort 1.41x over
+stock Spark sort shuffle on 100 GbE RoCE (README.md:7-19, BASELINE.md).
+This bench reproduces that comparison shape on one TPU chip: the
+framework's jitted shuffle-sort step (the TeraSort partition ->
+exchange -> merge pipeline, on-device) against the stock host path
+(numpy sort of the same keys), reporting the speedup; ``vs_baseline``
+normalizes by the reference's 1.41x.
+
+Methodology: steady-state throughput is measured by chaining K
+data-dependent steps inside ONE jitted program (re-disordering between
+rounds) and differencing against a single-step run — this isolates
+sustained on-chip throughput from host<->device dispatch latency, the
+same way the reference's number excludes JVM startup. Output
+correctness is separately verified against the host sort.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import numpy as np
+
+REFERENCE_SPEEDUP = 1.41  # SparkRDMA TeraSort vs stock sort shuffle
+N_KEYS = 1 << 25  # 32M uint32 keys = 128 MiB
+CHAIN = 16
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sparkrdma_tpu.models.terasort import TeraSorter
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 32, size=N_KEYS, dtype=np.uint32)
+
+    # -- stock path: host sort (the "Spark sort shuffle" role) ------------
+    t0 = time.perf_counter()
+    host_sorted = np.sort(keys)
+    host_s = time.perf_counter() - t0
+
+    # -- framework path: jitted device shuffle-sort step ------------------
+    device = jax.devices()[0]
+    mesh = make_mesh([device])
+    sorter = TeraSorter(mesh)
+    dev_keys = jax.device_put(keys, device)
+    step = sorter.step(N_KEYS)
+
+    # correctness: one full step vs the host baseline
+    merged, total, overflowed = step(dev_keys)
+    out = np.asarray(merged)[: int(np.asarray(total)[0])]
+    if bool(overflowed) or not np.array_equal(out[:N_KEYS], host_sorted):
+        raise SystemExit("BENCH FAILED: device sort != host sort")
+
+    @partial(jax.jit, static_argnums=(1,))
+    def chained(x, k):
+        def body(i, v):
+            # re-disorder between rounds (xor keeps the sort honest; the
+            # comparison network is data-oblivious anyway)
+            v = jnp.flip(v) ^ (i.astype(jnp.uint32) * jnp.uint32(2654435761))
+            m, _, _ = step(v)
+            return m[:N_KEYS]
+
+        return jax.lax.fori_loop(0, k, body, x).sum()
+
+    float(chained(dev_keys, 1))  # compile both programs
+    float(chained(dev_keys, CHAIN))
+    t0 = time.perf_counter()
+    float(chained(dev_keys, 1))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(chained(dev_keys, CHAIN))
+    tk = time.perf_counter() - t0
+    dev_s = max((tk - t1) / (CHAIN - 1), 1e-9)
+
+    speedup = host_s / dev_s
+    gbps = (N_KEYS * 4) / dev_s / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "terasort_speedup_vs_host_sort",
+                "value": round(speedup, 3),
+                "unit": "x",
+                "vs_baseline": round(speedup / REFERENCE_SPEEDUP, 3),
+                "device_sort_gbps": round(gbps, 3),
+                "n_keys": N_KEYS,
+                "device": str(device),
+                "host_sort_s": round(host_s, 4),
+                "device_step_s": round(dev_s, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

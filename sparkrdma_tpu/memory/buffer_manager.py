@@ -119,8 +119,11 @@ class TpuBufferManager:
             buf.free()
 
     def get_unregistered(self, length: int) -> TpuBuffer:
-        """Non-pooled, unregistered scratch allocation (chunk staging)."""
-        return TpuBuffer(None, length, register=False)
+        """Non-pooled, unregistered scratch allocation (chunk staging).
+
+        Arena-backed: scratch lifetime is framework-controlled, so the
+        native arena's unconditional free applies (see TpuBuffer)."""
+        return TpuBuffer(None, length, register=False, arena=True)
 
     def stats(self) -> Dict[int, int]:
         with self._lock:

@@ -1,0 +1,11 @@
+"""Benchmark workload models (the reference's HiBench role, SURVEY.md §6).
+
+The reference published exactly one number — TeraSort wall-clock
+(README.md:7-19) — with no benchmark code in-repo. This package IS
+that missing benchmark code for the TPU framework: fully-jittable
+distributed workloads built on the device exchange plane.
+"""
+
+from sparkrdma_tpu.models.terasort import TeraSorter
+
+__all__ = ["TeraSorter"]

@@ -1,0 +1,143 @@
+"""Device-resident distributed TeraSort — the framework's flagship workload.
+
+The reference's headline benchmark is HiBench TeraSort-175GB, 1.41x
+over stock Spark sort shuffle (README.md:7-19, BASELINE.md). Its
+pipeline is: map tasks range-partition records -> all-to-all shuffle
+over one-sided RDMA READ -> reduce tasks merge-sort their range
+(SURVEY.md §3.3-3.4). The TPU-native pipeline keeps the same three
+stages but runs them *where the bytes live*:
+
+  partition (radix on top key bits, on-device)
+    -> exchange (ExchangeProgram: lax.all_to_all over ICI/DCN)
+    -> merge (masked sort of the received slab, on-device)
+
+all inside ONE jitted SPMD program per (mesh, shard size, capacity) —
+compile-once / execute-many, the reference's SVC pattern. Output:
+shard i of the mesh holds the globally i-th sorted key range, sorted
+— i.e. a total global sort.
+
+Static-shape handling (SURVEY.md §7.3(2)): each peer bucket holds
+``capacity = ceil(N/E) * capacity_factor`` keys; the step returns an
+``overflowed`` flag instead of silently corrupting, and the host
+retries with the next capacity class — exactly how the registered
+pool re-rounds sizes.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.ops.sort import merge_received, pack_by_partition, radix_partition
+from sparkrdma_tpu.parallel.mesh import make_mesh, shard_spec
+
+KEY_BITS = 32
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
+class TeraSorter:
+    """Compile-once global sorter over a device mesh.
+
+    ``sort_sharded`` maps [E, n_local] uint32 keys (sharded over the
+    mesh) to [E, P*capacity] sorted rows plus per-shard valid counts;
+    row i's valid prefix is globally the i-th key range.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        capacity_factor: float = 2.0,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.num_shards = math.prod(self.mesh.shape.values())
+        if self.num_shards & (self.num_shards - 1):
+            raise ValueError("TeraSorter requires a power-of-two shard count")
+        self.capacity_factor = capacity_factor
+        self._step_cache = {}
+
+    # ------------------------------------------------------------------
+    def _build_step(self, n_local: int, capacity: int):
+        e = self.num_shards
+        axes = tuple(self.mesh.axis_names)
+        spec = shard_spec(self.mesh)
+
+        def shard_fn(keys):  # keys: [n_local] uint32 on one device
+            if e == 1:
+                # single-shard short circuit: no pack, no exchange — the
+                # reference's invariant #2 (local partitions never loop
+                # through the network, RdmaShuffleFetcherIterator.scala:328-339)
+                merged = jnp.sort(keys)
+                total = jnp.asarray([keys.shape[0]], jnp.int32)
+                return merged, total, jnp.zeros((), jnp.int32)
+            dest = radix_partition(keys, e, KEY_BITS)
+            slab, counts, overflowed = pack_by_partition(
+                keys, dest, e, capacity, fill=int(SENTINEL)
+            )
+            # one all_to_all delivers every peer's bucket — the one-sided
+            # READ plane collapsed into a single XLA collective
+            recv = jax.lax.all_to_all(slab, axes, split_axis=0, concat_axis=0, tiled=True)
+            rcounts = jax.lax.all_to_all(counts, axes, split_axis=0, concat_axis=0, tiled=True)
+            merged, total = merge_received(recv, rcounts, int(SENTINEL))
+            # any shard overflowing must abort the round everywhere
+            overflowed = jax.lax.pmax(overflowed.astype(jnp.int32), axes)
+            return merged, total[None], overflowed
+
+        fn = shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(spec,),
+            out_specs=(spec, spec, P()),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def step(self, n_local: int, capacity: Optional[int] = None):
+        """The jitted SPMD sort step for [E*n_local] global keys."""
+        if capacity is None:
+            capacity = self.default_capacity(n_local)
+        key = (n_local, capacity)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build_step(n_local, capacity)
+            self._step_cache[key] = fn
+        return fn
+
+    def default_capacity(self, n_local: int) -> int:
+        cap = int(math.ceil(n_local / self.num_shards) * self.capacity_factor)
+        return max(8, cap)
+
+    # ------------------------------------------------------------------
+    def sort(self, keys: np.ndarray) -> np.ndarray:
+        """Host-facing total sort of uint32 keys (pads to shard multiple).
+
+        Retries with doubled capacity on bucket overflow (skewed data),
+        mirroring the pool's size-class re-rounding."""
+        n = len(keys)
+        e = self.num_shards
+        n_local = int(math.ceil(n / e))
+        padded = np.full((e * n_local,), np.uint32(SENTINEL), dtype=np.uint32)
+        padded[:n] = keys
+        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
+        dev = jax.device_put(padded, sharding)
+
+        capacity = self.default_capacity(n_local)
+        for _ in range(8):
+            merged, totals, overflowed = self.step(n_local, capacity)(dev)
+            if not bool(overflowed):
+                break
+            capacity *= 2
+        else:
+            raise RuntimeError("terasort bucket overflow after 8 capacity doublings")
+
+        merged = np.asarray(merged).reshape(e, -1)
+        totals = np.asarray(totals).reshape(-1)
+        out = np.concatenate([merged[i, : totals[i]] for i in range(e)])
+        # drop the padding sentinels we injected (they sort to the tail)
+        return out[:n] if n < len(out) else out

@@ -1,0 +1,19 @@
+"""Device compute plane: exchange programs, HBM arenas, sort kernels.
+
+This package is the TPU-native replacement for the reference's verbs
+data plane (RdmaChannel.java one-sided READ machinery + RdmaBufferManager
+registered-memory pools): compile-once XLA exchange programs over a
+device mesh, size-classed HBM slab pools, and the on-device partition /
+sort kernels that make shuffle *compute* live where the bytes live.
+"""
+
+from sparkrdma_tpu.ops.exchange import ExchangeProgram, pack_blocks, unpack_blocks
+from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+
+__all__ = [
+    "ExchangeProgram",
+    "pack_blocks",
+    "unpack_blocks",
+    "DeviceBuffer",
+    "DeviceBufferManager",
+]

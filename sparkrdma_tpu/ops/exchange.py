@@ -1,0 +1,218 @@
+"""The resident exchange program — device-side all-to-all block transfer.
+
+TPU-native analogue of the reference's one-sided-READ data plane
+(IBV_WR_RDMA_READ WR lists, RdmaChannel.java:360-393). The verbs
+semantics are asynchronous, peer-passive, arbitrary-offset pulls;
+XLA collectives are synchronous SPMD with static shapes. Following
+SURVEY.md §7.3(1-2), the gap is bridged with:
+
+- **bucketed static shapes**: every peer-to-peer block rides in a
+  fixed-size bucket of ``block_bytes``; actual lengths travel alongside
+  as an int32 "length prefix" lane (the rkey/length analogue). Buckets
+  round to the conf's ``exchange.bucketMin``..``bucketMax`` power-of-two
+  classes, exactly like the registered-buffer pool's size classes
+  (RdmaBufferManager.java:103-118).
+- **compile-once, execute-many**: one jitted SPMD program per
+  (mesh, num rows, bucket) — the reference's stateful-verb-call
+  pattern (pre-serialized WR lists executed repeatedly,
+  RdmaChannel.java:185-192) becomes an XLA executable cache.
+- **ICI before DCN**: on a multi-slice ``(dcn, exec)`` mesh the
+  all-to-all runs over the flattened (dcn, exec) axes so XLA routes
+  intra-slice traffic on ICI and only cross-slice rows on DCN.
+
+Two transfer schedules are provided:
+
+- ``exchange``: single ``lax.all_to_all`` — XLA's native schedule,
+  best for dense all-to-all (the TeraSort repartition).
+- ``ring_exchange``: E-1 ``lax.ppermute`` steps moving one peer-block
+  per step around the ring — the staged, flow-controlled schedule
+  (analogue of ``maxBytesInFlight`` throttled fetches,
+  RdmaShuffleFetcherIterator.scala:279-284), and the building block
+  shared with ring-attention-style long-sequence exchange.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.parallel.mesh import shard_spec
+
+MIN_BUCKET = 1024
+
+
+def round_bucket(nbytes: int, lo: int = MIN_BUCKET, hi: int = 1 << 31) -> int:
+    """Round a block size up to its power-of-two bucket class.
+
+    Mirror of the registered-buffer pool's size classing
+    (RdmaBufferManager.java:103-118: power-of-two rounding, 16 KiB min —
+    buckets here may be smaller because device lanes are cheap).
+    """
+    n = max(lo, min(hi, nbytes))
+    return 1 << max(n - 1, 1).bit_length() if n > lo else lo
+
+
+def pack_blocks(
+    blocks: Sequence[bytes], block_bytes: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: pack one peer-block per row into a [E, block_bytes] send
+    buffer plus its length-prefix vector. Blocks longer than the bucket
+    are a caller bug (callers split at ``shuffleReadBlockSize`` first,
+    like AggregatedPartitionGroup packing)."""
+    e = len(blocks)
+    out = np.zeros((e, block_bytes), dtype=np.uint8)
+    counts = np.zeros((e,), dtype=np.int32)
+    for i, b in enumerate(blocks):
+        if len(b) > block_bytes:
+            raise ValueError(f"block {i} ({len(b)}B) exceeds bucket {block_bytes}B")
+        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        counts[i] = len(b)
+    return out, counts
+
+
+def unpack_blocks(recv: np.ndarray, counts: np.ndarray) -> List[bytes]:
+    """Host-side inverse of pack_blocks on the received side."""
+    return [recv[i, : int(counts[i])].tobytes() for i in range(recv.shape[0])]
+
+
+class ExchangeProgram:
+    """Compile-once all-to-all exchange over a mesh.
+
+    Global layout: ``send`` is [E*rows, block] sharded on dim 0 over all
+    mesh axes; each device's local [rows, block] slab holds one
+    outgoing block per peer-row (rows == E for a plain all-to-all;
+    multiples of E for multi-block rounds). ``counts`` is the int32
+    length-prefix array of the same leading shape.
+
+    After the exchange, device *i*'s local row *j* holds what device
+    *j* staged for device *i* — the device analogue of "reduce task
+    pulls its partition from every map output" (SURVEY.md §3.4).
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        # Collective axis order MUST match the sharding's global shard
+        # order (dcn-major, exec-minor) so that send-row j lands on the
+        # device holding global shard j. XLA still routes the intra-slice
+        # component over ICI; the order here is index math, not routing.
+        self.axes = tuple(mesh.axis_names)
+        self.num_shards = math.prod(mesh.shape[a] for a in self.axes)
+        self._all_to_all_cache = {}
+        self._ring_cache = {}
+
+    # -- schedule 1: XLA-native dense all-to-all ---------------------------
+    def _build_all_to_all(self, rows: int, block: int, dtype) -> "jax.stages.Wrapped":
+        axes = self.axes
+        spec = shard_spec(self.mesh)
+        cspec = spec
+
+        def shard_fn(send, counts):
+            # send: [rows, block]; row j is the block bound for peer j.
+            # tiled all_to_all: row j goes to device j, received rows
+            # concatenate in peer order — one-sided semantics, no peer code.
+            recv = jax.lax.all_to_all(
+                send, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+            rcounts = jax.lax.all_to_all(
+                counts, axes, split_axis=0, concat_axis=0, tiled=True
+            )
+            return recv, rcounts
+
+        fn = shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(spec, cspec),
+            out_specs=(spec, cspec),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def exchange(self, send, counts):
+        """Dense exchange; returns (recv, recv_counts) with identical shapes.
+
+        ``send``: [E*rows_per_shard, block] (any dtype), sharded or
+        shardable over the mesh; ``counts``: [E*rows_per_shard] int32.
+        """
+        rows = send.shape[0] // self.num_shards
+        key = ("a2a", rows, send.shape[1:], jnp.dtype(send.dtype).name)
+        fn = self._all_to_all_cache.get(key)
+        if fn is None:
+            fn = self._build_all_to_all(rows, send.shape[1], send.dtype)
+            self._all_to_all_cache[key] = fn
+        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
+        send = jax.device_put(send, sharding)
+        counts = jax.device_put(counts, sharding)
+        return fn(send, counts)
+
+    # -- schedule 2: staged ring (ppermute) --------------------------------
+    def _build_ring(self, block: int, dtype) -> "jax.stages.Wrapped":
+        if len(self.axes) != 1:
+            raise NotImplementedError("ring schedule requires a 1-D mesh")
+        axis = self.axes[0]
+        e = self.num_shards
+        spec = shard_spec(self.mesh)
+
+        def shard_fn(send, counts):
+            # send: [E, block]; deliver row j to device j by rotating the
+            # slab around the ring, peeling off the arriving row each hop
+            # — only neighbour links are ever used (the topology ring
+            # attention shares), and each device has a bounded amount in
+            # flight per step (the maxBytesInFlight-style staging).
+            me = jax.lax.axis_index(axis)
+            recv0 = send[me]  # my own row short-circuits locally
+            rcount0 = counts[me]
+            perm_fwd = [(i, (i + 1) % e) for i in range(e)]
+
+            slab = send
+            ccnt = counts
+            outs = []
+            couts = []
+            for k in range(1, e):
+                slab = jax.lax.ppermute(slab, axis, perm_fwd)
+                ccnt = jax.lax.ppermute(ccnt, axis, perm_fwd)
+                # after k hops the slab on me originated at device me-k;
+                # its row `me` is the block that device staged for me.
+                outs.append(slab[me])
+                couts.append(ccnt[me])
+
+            # reassemble receive slab in peer order: row j came from peer j
+            # = me - k mod e at hop k. Scatter hop results to peer rows.
+            recv = jnp.zeros_like(send)
+            rcounts = jnp.zeros_like(counts)
+            recv = recv.at[me].set(recv0)
+            rcounts = rcounts.at[me].set(rcount0)
+            for k in range(1, e):
+                src = (me - k) % e
+                recv = recv.at[src].set(outs[k - 1])
+                rcounts = rcounts.at[src].set(couts[k - 1])
+            return recv, rcounts
+
+        fn = shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(spec, spec),
+            out_specs=(spec, spec),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def ring_exchange(self, send, counts):
+        """Staged exchange: E-1 ppermute hops, one bucket in flight each.
+
+        Semantically identical to ``exchange``; schedule differs (ring
+        neighbours only — the pattern ring attention shares)."""
+        key = ("ring", send.shape[1:], jnp.dtype(send.dtype).name)
+        fn = self._ring_cache.get(key)
+        if fn is None:
+            fn = self._build_ring(send.shape[1], send.dtype)
+            self._ring_cache[key] = fn
+        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
+        send = jax.device_put(send, sharding)
+        counts = jax.device_put(counts, sharding)
+        return fn(send, counts)

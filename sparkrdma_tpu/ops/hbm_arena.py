@@ -1,0 +1,231 @@
+"""HBM slab pool + handle table — the device registered-memory plane.
+
+Device analogue of the host registered-buffer pool
+(RdmaBufferManager.java): size-classed stacks of uint8 slabs resident
+in device HBM, power-of-two rounding with a 16 KiB floor
+(RdmaBufferManager.java:103-118), per-class allocation statistics
+printed at shutdown (:131-141), and an optional preallocation pass
+(:84-91).
+
+The rkey/address concept (RdmaBlockLocation's ``(address, length,
+mkey)``, RdmaPartitionLocation.scala:25) maps to ``(device ordinal,
+handle, offset, length)``: the handle table resolves a handle to a
+live ``jax.Array`` slab, so any framework component — the fetcher
+staging received blocks, the exchange program sourcing send slabs —
+can name device memory without holding the array itself.
+
+``jax.Array`` is immutable, so "writing into a slab" means staging a
+new array and retiring the old one under the same handle; pooling here
+buys *budget accounting* and handle stability rather than malloc reuse
+(XLA's allocator handles that). The budget mirrors the reference's
+executor-wide in-memory cap (``shuffleWriteMaxInMemoryStoragePerExecutor``,
+RdmaShuffleBlockResolver.scala:38-47) via ``hbm.maxBytes``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MIN_BLOCK_SIZE = 16 * 1024  # RdmaBufferManager.java MIN_BLOCK_SIZE analogue
+
+
+def _size_class(nbytes: int) -> int:
+    """Round up to a power of two, floored at MIN_BLOCK_SIZE."""
+    n = max(nbytes, MIN_BLOCK_SIZE)
+    return 1 << (n - 1).bit_length()
+
+
+class DeviceBuffer:
+    """One pooled HBM slab plus the live view of its contents.
+
+    ``length`` is the caller-requested byte length; ``capacity`` the
+    size-class slab length actually resident. ``array`` always has
+    shape [capacity] dtype uint8.
+    """
+
+    __slots__ = ("handle", "capacity", "length", "array", "_manager")
+
+    def __init__(self, handle: int, capacity: int, array, manager):
+        self.handle = handle
+        self.capacity = capacity
+        self.length = 0
+        self.array = array
+        self._manager = manager
+
+    @property
+    def device(self):
+        return next(iter(self.array.devices()))
+
+    def stage(self, data: bytes) -> "DeviceBuffer":
+        """Host -> HBM: replace the slab contents (pads to capacity)."""
+        if len(data) > self.capacity:
+            raise ValueError(f"{len(data)}B exceeds slab capacity {self.capacity}B")
+        host = np.zeros((self.capacity,), dtype=np.uint8)
+        host[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+        old = self.array
+        self.array = jax.device_put(host, self.device)
+        old.delete()
+        self.length = len(data)
+        return self
+
+    def put_array(self, arr) -> "DeviceBuffer":
+        """Adopt a device-resident uint8 array as the slab contents."""
+        if arr.dtype != jnp.uint8 or arr.ndim != 1:
+            raise ValueError("slab contents must be 1-D uint8")
+        if arr.shape[0] > self.capacity:
+            raise ValueError("array exceeds slab capacity")
+        self.length = arr.shape[0]
+        old = self.array
+        if arr.shape[0] < self.capacity:
+            arr = jnp.zeros((self.capacity,), dtype=jnp.uint8).at[: arr.shape[0]].set(arr)
+        self.array = arr
+        old.delete()
+        return self
+
+    def read(self, offset: int = 0, length: Optional[int] = None) -> bytes:
+        """HBM -> host readback of ``[offset, offset+length)``."""
+        if length is None:
+            length = self.length - offset
+        if offset < 0 or length < 0 or offset + length > self.capacity:
+            raise ValueError("read out of slab bounds")
+        return np.asarray(self.array[offset : offset + length]).tobytes()
+
+    def free(self) -> None:
+        self._manager.put(self)
+
+
+class _AllocatorStack:
+    """Lock-guarded per-size-class free stack with a cumulative
+    allocation counter (reference AllocatorStack,
+    RdmaBufferManager.java:31-71)."""
+
+    __slots__ = ("size", "stack", "total_alloc", "total_gets")
+
+    def __init__(self, size: int):
+        self.size = size
+        self.stack: List[DeviceBuffer] = []
+        self.total_alloc = 0
+        self.total_gets = 0
+
+
+class DeviceBufferManager:
+    """Size-classed pool of HBM slabs for one device."""
+
+    def __init__(self, device=None, max_bytes: int = 0, prealloc: int = 0,
+                 prealloc_size: int = 0):
+        if device is None:
+            device = jax.devices()[0]
+        self.device = device
+        self.max_bytes = max_bytes  # 0 = unbounded
+        self._stacks: Dict[int, _AllocatorStack] = {}
+        self._handles: Dict[int, DeviceBuffer] = {}
+        self._next_handle = 1
+        self._in_use_bytes = 0
+        self._lock = threading.Lock()
+        self._stopped = False
+        # optional warm-up (reference maxAggPrealloc, RdmaBufferManager.java:84-91)
+        if prealloc > 0 and prealloc_size > 0:
+            bufs = [self.get(prealloc_size) for _ in range(prealloc)]
+            for b in bufs:
+                b.free()
+
+    # ------------------------------------------------------------------
+    def get(self, nbytes: int) -> DeviceBuffer:
+        """Allocate (or reuse) a slab whose class covers ``nbytes``."""
+        cls = _size_class(nbytes)
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("DeviceBufferManager is stopped")
+            stack = self._stacks.setdefault(cls, _AllocatorStack(cls))
+            stack.total_gets += 1
+            if stack.stack:
+                buf = stack.stack.pop()
+                buf.length = nbytes
+                self._in_use_bytes += cls
+                self._handles[buf.handle] = buf
+                return buf
+            if self.max_bytes and self._in_use_bytes + cls > self.max_bytes:
+                raise MemoryError(
+                    f"HBM shuffle budget exceeded: in-use {self._in_use_bytes}B "
+                    f"+ {cls}B > cap {self.max_bytes}B"
+                )
+            handle = self._next_handle
+            self._next_handle += 1
+            stack.total_alloc += 1
+            self._in_use_bytes += cls
+        arr = jax.device_put(jnp.zeros((cls,), dtype=jnp.uint8), self.device)
+        buf = DeviceBuffer(handle, cls, arr, self)
+        buf.length = nbytes
+        with self._lock:
+            self._handles[handle] = buf
+        return buf
+
+    def put(self, buf: DeviceBuffer) -> None:
+        """Return a slab to its class stack (RdmaBufferManager.java:120-127)."""
+        with self._lock:
+            if self._handles.pop(buf.handle, None) is None:
+                return  # double-free tolerated, like onFailure reentry
+            self._in_use_bytes -= buf.capacity
+            if self._stopped:
+                buf.array.delete()
+                return
+            self._stacks[buf.capacity].stack.append(buf)
+        buf.length = 0
+
+    def resolve(self, handle: int) -> DeviceBuffer:
+        """Handle table lookup — the mkey/rkey resolution analogue."""
+        with self._lock:
+            buf = self._handles.get(handle)
+        if buf is None:
+            raise KeyError(f"no live device buffer for handle {handle}")
+        return buf
+
+    def stage_bytes(self, data: bytes) -> DeviceBuffer:
+        """Pool + stage in one step (host bytes -> registered HBM slab)."""
+        return self.get(len(data)).stage(data)
+
+    # ------------------------------------------------------------------
+    @property
+    def in_use_bytes(self) -> int:
+        with self._lock:
+            return self._in_use_bytes
+
+    def stats(self) -> Dict[int, Dict[str, int]]:
+        with self._lock:
+            return {
+                size: {
+                    "total_alloc": s.total_alloc,
+                    "total_gets": s.total_gets,
+                    "pooled": len(s.stack),
+                }
+                for size, s in self._stacks.items()
+            }
+
+    def stop(self) -> None:
+        """Free everything; log per-class stats (RdmaBufferManager.java:131-141)."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            stacks = list(self._stacks.values())
+            leaked = list(self._handles.values())
+        for s in stacks:
+            if s.total_alloc:
+                logger.info(
+                    "hbm pool class %dB: allocated %d, gets %d, pooled %d",
+                    s.size, s.total_alloc, s.total_gets, len(s.stack),
+                )
+            for buf in s.stack:
+                buf.array.delete()
+            s.stack.clear()
+        for buf in leaked:
+            logger.warning("hbm slab handle %d leaked (freeing)", buf.handle)
+            buf.array.delete()

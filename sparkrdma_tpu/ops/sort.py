@@ -1,0 +1,83 @@
+"""On-device partition / pack / merge kernels for the shuffle compute path.
+
+The reference's write path serializes records into per-partition
+blocks (sort-shuffle files or registered chunks, SURVEY.md §3.3); the
+read path re-aggregates blocks per source (§3.4). On TPU the same
+stages become dense vector ops that XLA fuses:
+
+- ``radix_partition``: dest-partition assignment from the key's top
+  bits (the range partitioner of TeraSort),
+- ``pack_by_partition``: stable counting-sort layout into a
+  [num_partitions, capacity] bucketed send slab + counts — static
+  shapes with a length prefix per row, overflow *detected* rather than
+  avoided (host re-runs with the next bucket class, like the pool's
+  power-of-two re-rounding),
+- ``merge_received``: mask + sort of the post-exchange slab.
+
+All functions are jit-safe (static shapes, no data-dependent Python
+control flow).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def radix_partition(keys: jax.Array, num_partitions: int, key_bits: int = 32) -> jax.Array:
+    """Destination partition per key from its top log2(P) bits.
+
+    ``num_partitions`` must be a power of two (TeraSort's uniform key
+    space makes top-bit ranges perfectly balanced)."""
+    if num_partitions & (num_partitions - 1):
+        raise ValueError("num_partitions must be a power of two")
+    shift = key_bits - (num_partitions.bit_length() - 1)
+    if shift >= key_bits:
+        return jnp.zeros(keys.shape, dtype=jnp.int32)
+    return (keys >> shift).astype(jnp.int32)
+
+
+def pack_by_partition(
+    values: jax.Array, dest: jax.Array, num_partitions: int, capacity: int,
+    fill: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Stable counting-sort scatter of ``values`` into fixed rows.
+
+    Returns ``(slab [P, capacity], counts [P], overflowed scalar bool)``.
+    Rows hold each partition's values in input order, padded with
+    ``fill``; entries beyond a row's count are padding. If any
+    partition exceeds ``capacity`` its surplus is clamped into the last
+    slot and ``overflowed`` is set — callers must check it and retry
+    with a larger bucket class (static shapes forbid growing in-kernel).
+    """
+    n = values.shape[0]
+    counts = jnp.bincount(dest, length=num_partitions).astype(jnp.int32)
+    overflowed = jnp.any(counts > capacity)
+    # stable sort by destination gives contiguous per-partition runs
+    order = jnp.argsort(dest, stable=True)
+    sorted_vals = values[order]
+    sorted_dest = dest[order]
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    # rank within the run = global sorted position - run start
+    pos = jnp.arange(n, dtype=jnp.int32) - starts[sorted_dest]
+    pos = jnp.minimum(pos, capacity - 1)  # clamp overflow into last slot
+    slab = jnp.full((num_partitions, capacity), fill, dtype=values.dtype)
+    slab = slab.at[sorted_dest, pos].set(sorted_vals, mode="drop")
+    return slab, jnp.minimum(counts, capacity), overflowed
+
+
+def merge_received(
+    slab: jax.Array, counts: jax.Array, sentinel: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Mask padding to ``sentinel`` and sort the flattened slab.
+
+    Returns ``(sorted flat values, total valid count)``; valid entries
+    occupy the prefix when ``sentinel`` is the dtype max."""
+    p, cap = slab.shape
+    valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]
+    flat = jnp.where(valid, slab, jnp.asarray(sentinel, slab.dtype)).reshape(-1)
+    return jnp.sort(flat), counts.sum()

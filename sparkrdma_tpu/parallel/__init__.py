@@ -1,0 +1,26 @@
+"""Device-mesh plane: mesh construction and sharding helpers.
+
+The reference scales by a full mesh of executor QPs over RoCE
+(RdmaNode.java:281-353); the TPU framework scales by a
+``jax.sharding.Mesh`` whose axes ride ICI (intra-slice) and DCN
+(inter-slice). This package owns mesh construction and the sharding
+vocabulary used by the exchange plane (SURVEY.md §2.4, §7.1).
+"""
+
+from sparkrdma_tpu.parallel.mesh import (
+    exec_axis,
+    dcn_axis,
+    make_mesh,
+    mesh_axis_size,
+    shard_spec,
+    replicated_spec,
+)
+
+__all__ = [
+    "exec_axis",
+    "dcn_axis",
+    "make_mesh",
+    "mesh_axis_size",
+    "shard_spec",
+    "replicated_spec",
+]

@@ -1,0 +1,103 @@
+"""Mesh construction for the TPU exchange plane.
+
+The reference's communication topology is a lazily-connected full mesh
+of RC queue pairs between executors (RdmaNode.java:281-353), with the
+driver as a TPU-free metadata hub (SURVEY.md §3.1). The TPU-native
+topology is a ``jax.sharding.Mesh``:
+
+- the ``"exec"`` axis is the executor ring — devices within one slice,
+  connected by ICI; collectives over it are the analogue of the
+  executor<->executor one-sided READ plane,
+- the optional ``"dcn"`` axis is the inter-slice dimension — multi-pod
+  scale-out where collectives ride DCN, the analogue of routed RoCE
+  between racks.
+
+No QP state is kept anywhere: the mesh *is* the membership, and XLA's
+collectives are compiled against it once (the SVC compile-once /
+execute-many pattern of the reference's stateful verb calls,
+RdmaChannel.java:185-192, becomes jit compile-once / call-many).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis names used across the framework.
+EXEC_AXIS = "exec"
+DCN_AXIS = "dcn"
+
+
+def exec_axis() -> str:
+    return EXEC_AXIS
+
+
+def dcn_axis() -> str:
+    return DCN_AXIS
+
+
+def _infer_num_slices(devices: Sequence[jax.Device]) -> int:
+    """Group devices by slice (DCN domain) when the platform reports one."""
+    slice_ids = []
+    for d in devices:
+        sid = getattr(d, "slice_index", None)
+        if sid is None:
+            return 1
+        slice_ids.append(sid)
+    return len(set(slice_ids))
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_slices: Optional[int] = None,
+) -> Mesh:
+    """Build the framework mesh: ``(dcn, exec)`` if multi-slice, else ``(exec,)``.
+
+    ``num_slices`` overrides slice detection (useful for simulating DCN
+    topology on a CPU device farm, SURVEY.md §4's
+    multi-node-without-a-cluster strategy).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if num_slices is None:
+        num_slices = _infer_num_slices(devices)
+    if num_slices <= 1:
+        return Mesh(np.array(devices), (EXEC_AXIS,))
+    if n % num_slices != 0:
+        raise ValueError(
+            f"{n} devices do not divide into {num_slices} slices"
+        )
+    arr = np.array(devices).reshape(num_slices, n // num_slices)
+    return Mesh(arr, (DCN_AXIS, EXEC_AXIS))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str = EXEC_AXIS) -> int:
+    return mesh.shape[axis]
+
+
+def all_exchange_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Every mesh axis, innermost (ICI) first — exchange order matters:
+    intra-slice traffic should ride ICI before anything crosses DCN."""
+    names = list(mesh.axis_names)
+    names.reverse()  # exec (ICI) first, dcn last
+    return tuple(names)
+
+
+def shard_spec(mesh: Mesh) -> PartitionSpec:
+    """PartitionSpec sharding dim 0 over every mesh axis (dcn outermost)."""
+    if len(mesh.axis_names) == 1:
+        return PartitionSpec(EXEC_AXIS)
+    return PartitionSpec((DCN_AXIS, EXEC_AXIS))
+
+
+def replicated_spec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def sharding_for(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, shard_spec(mesh))

@@ -111,20 +111,6 @@ class TpuShuffleFetcherIterator:
 
     # ------------------------------------------------------------------
     def _start(self) -> None:
-        # local partitions short-circuit (:328-339)
-        resolver = self._manager.resolver
-        local_streams: List[Tuple[int, BinaryIO]] = []
-        for pid in range(self.start_partition, self.end_partition):
-            for stream in resolver.get_local_partition_streams(
-                self._handle.shuffle_id, pid
-            ):
-                local_streams.append((pid, stream))
-                self.metrics.local_blocks += 1
-        if local_streams:
-            with self._lock:
-                self._total_results += 1
-            self._results.put(_Success(local_streams))
-
         threading.Thread(
             target=self._resolve_and_fetch, name="fetcher-locations", daemon=True
         ).start()
@@ -156,11 +142,37 @@ class TpuShuffleFetcherIterator:
             (time.monotonic() - t0) * 1e3,
         )
 
+        # Local partitions short-circuit to streams (:328-339) — served
+        # HERE, after the driver's barrier-gated reply, not at iterator
+        # construction: a snapshot taken earlier would race local map
+        # tasks that finish after the reader starts and silently drop
+        # their records. The reply is complete by construction, so the
+        # resolver now holds every local block the reply names.
         my_id = self._manager.executor_id
+        resolver = self._manager.resolver
+        local_pids = sorted(
+            {
+                loc.partition_id
+                for loc in locations
+                if loc.manager_id.executor_id == my_id
+            }
+        )
+        local_streams: List[Tuple[int, BinaryIO]] = []
+        for pid in local_pids:
+            for stream in resolver.get_local_partition_streams(
+                self._handle.shuffle_id, pid
+            ):
+                local_streams.append((pid, stream))
+                self.metrics.local_blocks += 1
+        if local_streams:
+            with self._lock:
+                self._total_results += 1
+            self._results.put(_Success(local_streams))
+
         by_manager: Dict[ShuffleManagerId, List[Tuple[int, BlockLocation]]] = {}
         for loc in locations:
             if loc.manager_id.executor_id == my_id:
-                continue  # already served locally
+                continue  # served locally above
             by_manager.setdefault(loc.manager_id, []).append((loc.partition_id, loc.block))
 
         # pack per-manager groups ≤ read_block_size (:252-275)

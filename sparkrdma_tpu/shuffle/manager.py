@@ -66,6 +66,9 @@ class TpuShuffleManager:
         # map-output tracking: fetch replies wait for shuffle completeness
         self._maps_done: Dict[int, int] = {}
         self._deferred_fetches: Dict[int, List[FetchPartitionLocationsMsg]] = {}
+        # per-executor attribution of published map outputs, so peer loss
+        # can re-arm the barrier (shuffle_id -> executor_id -> count)
+        self._maps_by_exec: Dict[int, Dict[str, int]] = {}
 
         # executor state
         self._fetch_futures: Dict[Tuple[int, int], Future] = {}
@@ -228,6 +231,14 @@ class TpuShuffleManager:
                 if msg.is_last and msg.num_map_outputs > 0:
                     done = self._maps_done.get(msg.shuffle_id, 0) + msg.num_map_outputs
                     self._maps_done[msg.shuffle_id] = done
+                    if msg.locations:
+                        # attribute to the publishing executor so its loss
+                        # re-arms the barrier; empty publishes (maps with
+                        # no output data) have nothing to lose and stay
+                        # counted unconditionally
+                        exec_id = msg.locations[0].manager_id.executor_id
+                        by_exec = self._maps_by_exec.setdefault(msg.shuffle_id, {})
+                        by_exec[exec_id] = by_exec.get(exec_id, 0) + msg.num_map_outputs
                     handle = self._registered.get(msg.shuffle_id)
                     if handle is not None and done >= handle.num_maps:
                         to_reply = self._deferred_fetches.pop(msg.shuffle_id, [])
@@ -246,7 +257,13 @@ class TpuShuffleManager:
             future.set_result(locs)
 
     def _on_peer_lost(self, executor_id: str) -> None:
-        """Driver: prune a lost executor's locations (:199-221)."""
+        """Driver: prune a lost executor's locations (:199-221).
+
+        Also subtracts the executor's published map outputs from the
+        completeness barrier, so later fetches defer (and eventually
+        time out into MetadataFetchFailedError on the reducer) instead
+        of receiving a complete-looking but incomplete location set —
+        the reference's missing-MapStatus semantics."""
         if not self.is_driver:
             return
         with self._lock:
@@ -258,6 +275,12 @@ class TpuShuffleManager:
                         for loc in shuffle[pid]
                         if loc.manager_id.executor_id != executor_id
                     ]
+            for shuffle_id, by_exec in self._maps_by_exec.items():
+                lost = by_exec.pop(executor_id, 0)
+                if lost:
+                    self._maps_done[shuffle_id] = (
+                        self._maps_done.get(shuffle_id, 0) - lost
+                    )
         logger.info("pruned locations of lost executor %s", executor_id)
 
     # ------------------------------------------------------------------
@@ -353,6 +376,7 @@ class TpuShuffleManager:
             self._registered.pop(shuffle_id, None)
             self._maps_done.pop(shuffle_id, None)
             self._deferred_fetches.pop(shuffle_id, None)
+            self._maps_by_exec.pop(shuffle_id, None)
 
     # ------------------------------------------------------------------
     def get_channel_to(self, mid: ShuffleManagerId):

@@ -20,9 +20,12 @@ rdma/writer/chunkedpartitionagg/). Semantics preserved:
   SURVEY.md §5.1 "known quirks").
 
 Trade-off vs Wrapper (as in the reference): no per-map data removal —
-aggregated logs mix map outputs, so a failed map task invalidates the
-whole shuffle's data on this executor (remove_data_by_map degrades to
-dispose-on-failure).
+aggregated logs mix map outputs, so a failed map task that already
+flushed frames **poisons** the shuffle's data on this executor:
+``finalize_and_publish`` then refuses to publish (raising
+ShuffleError), forcing the stage to re-run under a fresh shuffle id —
+which is exactly how the engine retries failed map stages. A failed
+map that never flushed leaves the logs clean and does not poison.
 """
 
 from __future__ import annotations
@@ -56,6 +59,7 @@ class ChunkedAggShuffleData(ShuffleData):
         self._active_shuffle_writers = 0
         self._committed_maps = 0
         self._published = False
+        self._poisoned = False
 
     def partition_writer(self, pid: int) -> PartitionWriter:
         with self._lock:
@@ -80,11 +84,15 @@ class ChunkedAggShuffleData(ShuffleData):
             self._active_shuffle_writers -= 1
             self._committed_maps += 1
 
-    def abort_map_output(self) -> None:
+    def abort_map_output(self, dirty: bool = False) -> None:
         """A map task failed: it must NOT count toward the driver's
-        map-output barrier (its stage will re-run)."""
+        map-output barrier (its stage will re-run). ``dirty`` means the
+        task already flushed frames into the shared logs, which cannot
+        be excised — the whole shuffle's data here is now unpublishable."""
         with self._lock:
             self._active_shuffle_writers -= 1
+            if dirty:
+                self._poisoned = True
 
     def finalize_and_publish(self, manager) -> None:
         """Publish the aggregated location set once, at the map barrier.
@@ -93,6 +101,16 @@ class ChunkedAggShuffleData(ShuffleData):
         the driver's map-output count completes.
         """
         with self._lock:
+            if self._poisoned:
+                # a failed map's frames are interleaved in the shared
+                # logs; publishing would duplicate its records when the
+                # stage re-runs — refuse, forcing a fresh shuffle id
+                from sparkrdma_tpu.shuffle.errors import ShuffleError
+
+                raise ShuffleError(
+                    f"shuffle {self.shuffle_id} chunked-agg data poisoned by a "
+                    "failed map task; stage must re-run under a fresh shuffle id"
+                )
             if self._published or self._committed_maps == 0:
                 return
             if self._active_shuffle_writers > 0:
@@ -150,6 +168,7 @@ class ChunkedAggShuffleWriter:
         self._recycled: List = []
         self._lengths = [0] * handle.num_partitions
         self._stopped = False
+        self._dirty = False  # True once a frame reached the shared logs
 
     def _stream(self, pid: int) -> ChunkedByteBufferOutputStream:
         s = self._streams.get(pid)
@@ -173,6 +192,7 @@ class ChunkedAggShuffleWriter:
         framed = frame_compressed(self._codec, raw)
         self._data.partition_writer(pid).append_frame(framed)
         self._lengths[pid] += len(framed)
+        self._dirty = True
 
     def write(self, records) -> None:
         part = self._handle.partitioner.partition
@@ -204,5 +224,5 @@ class ChunkedAggShuffleWriter:
         if success:
             self._data.commit_map_output()
             return MapStatus(self.map_id, self._lengths)
-        self._data.abort_map_output()
+        self._data.abort_map_output(dirty=self._dirty)
         return None

@@ -49,7 +49,14 @@ class ChunkedByteBufferOutputStream:
         recycled: Optional[List[TpuBuffer]] = None,
     ):
         self.chunk_size = chunk_size
-        self._allocate = allocate or (lambda n: TpuBuffer(None, n, register=False))
+        # chunk scratch is framework-owned (copied out at flush, freed or
+        # recycled by the writer; no consumer view outlives it) — the
+        # native C++ arena's unconditional free is safe here, and this is
+        # the serialize-hot-path the reference used Unsafe.allocateMemory
+        # for (RdmaBuffer.java:55-64)
+        self._allocate = allocate or (
+            lambda n: TpuBuffer(None, n, register=False, arena=True)
+        )
         self._recycled = recycled or []
         self._chunks: List[TpuBuffer] = []
         self._pos_in_chunk = 0

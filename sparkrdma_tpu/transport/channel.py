@@ -273,8 +273,21 @@ class TpuChannel:
             # unknown completion: drain the payload to keep framing intact
             wire.read_exact(self._sock, total)
             return
-        for view in pending.dst_views:
-            wire.read_into(self._sock, view)
+        try:
+            for view in pending.dst_views:
+                wire.read_into(self._sock, view)
+        except Exception as e:
+            # the entry was already popped from _pending_reads, so the
+            # error latch can no longer see it — fail its listener here
+            # before propagating, or the reduce task waits forever
+            if pending.listener:
+                try:
+                    pending.listener.on_failure(
+                        ChannelError(f"READ payload from {self.peer_desc} truncated: {e}")
+                    )
+                except Exception:
+                    logger.exception("listener on_failure raised")
+            raise
         self._reclaim(pending.permits)
         if pending.listener:
             pending.listener.on_success(total)

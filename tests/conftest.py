@@ -14,3 +14,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU plugin (this image's tunnel to the real chip) overrides
+# JAX_PLATFORMS at import time; pin the platform via jax.config too so
+# CI sharding tests always see the 8 virtual CPU devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

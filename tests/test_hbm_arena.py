@@ -1,0 +1,79 @@
+"""HBM slab pool tests — the device registered-memory plane.
+
+Mirrors the buffer-pool property targets (reuse/leak accounting,
+RdmaBufferManager.java:131-141; power-of-two size classing :103-118)."""
+
+import pytest
+
+from sparkrdma_tpu.ops.hbm_arena import (
+    MIN_BLOCK_SIZE,
+    DeviceBuffer,
+    DeviceBufferManager,
+    _size_class,
+)
+
+
+def test_size_class_rounding():
+    assert _size_class(1) == MIN_BLOCK_SIZE
+    assert _size_class(MIN_BLOCK_SIZE) == MIN_BLOCK_SIZE
+    assert _size_class(MIN_BLOCK_SIZE + 1) == MIN_BLOCK_SIZE * 2
+    assert _size_class(1 << 20) == 1 << 20
+
+
+def test_stage_read_roundtrip():
+    mgr = DeviceBufferManager()
+    data = bytes(range(256)) * 100
+    buf = mgr.stage_bytes(data)
+    assert buf.length == len(data)
+    assert buf.capacity >= len(data)
+    assert buf.read() == data
+    assert buf.read(16, 16) == data[16:32]
+    buf.free()
+    mgr.stop()
+
+
+def test_pool_reuse_same_class():
+    mgr = DeviceBufferManager()
+    a = mgr.get(20_000)
+    h = a.handle
+    a.free()
+    b = mgr.get(30_000)  # same 32 KiB class -> reused slab
+    assert b.handle == h
+    stats = mgr.stats()
+    cls = _size_class(20_000)
+    assert stats[cls]["total_alloc"] == 1
+    assert stats[cls]["total_gets"] == 2
+    b.free()
+    mgr.stop()
+
+
+def test_handle_table_resolution():
+    mgr = DeviceBufferManager()
+    buf = mgr.stage_bytes(b"registered")
+    assert mgr.resolve(buf.handle) is buf
+    buf.free()
+    with pytest.raises(KeyError):
+        mgr.resolve(buf.handle)
+    mgr.stop()
+
+
+def test_budget_enforced():
+    mgr = DeviceBufferManager(max_bytes=MIN_BLOCK_SIZE * 2)
+    a = mgr.get(1)
+    b = mgr.get(1)
+    with pytest.raises(MemoryError):
+        mgr.get(1)
+    a.free()
+    c = mgr.get(1)  # freed capacity is available again
+    b.free()
+    c.free()
+    mgr.stop()
+
+
+def test_double_free_tolerated():
+    mgr = DeviceBufferManager()
+    buf = mgr.get(1)
+    buf.free()
+    buf.free()  # like RdmaCompletionListener.onFailure: reentry tolerated
+    assert mgr.in_use_bytes == 0
+    mgr.stop()

@@ -188,3 +188,118 @@ def test_fetch_defers_until_maps_complete():
         assert results["out"] == [("a", 1), ("b", 2), ("c", 3)]
     finally:
         _stop_all(ex0, ex1, driver)
+
+
+def test_early_reader_sees_late_local_map_output():
+    """Regression: a reducer that starts BEFORE a local map task on the
+    same executor finishes must still receive that map's records (the
+    local short-circuit must not snapshot before the barrier)."""
+    conf, driver, ex0, ex1 = _cluster("wrapper")
+    try:
+        handle = BaseShuffleHandle(shuffle_id=0, num_maps=2, partitioner=HashPartitioner(1))
+        driver.register_shuffle(handle)
+        # map 0 on ex0 completes first
+        w0 = ex0.get_writer(handle, 0)
+        w0.write(iter([("a", 1)]))
+        w0.stop(True)
+
+        results = {}
+
+        def read_early():
+            results["out"] = sorted(ex0.get_reader(handle, 0, 1).read())
+
+        t = threading.Thread(target=read_early)
+        t.start()
+        import time
+
+        time.sleep(0.3)  # reader is deferred on the driver barrier
+        # map 1 ALSO on ex0 finishes after the reader started
+        w1 = ex0.get_writer(handle, 1)
+        w1.write(iter([("b", 2)]))
+        w1.stop(True)
+        t.join(10)
+        assert not t.is_alive()
+        assert results["out"] == [("a", 1), ("b", 2)]
+    finally:
+        _stop_all(ex0, ex1, driver)
+
+
+def test_peer_loss_rearms_map_output_barrier():
+    """Regression: after an executor with published outputs dies, a new
+    fetch must NOT be answered with a complete-looking location set —
+    it defers (and the reducer times out into MetadataFetchFailedError)."""
+    import time
+
+    from sparkrdma_tpu.shuffle.errors import MetadataFetchFailedError
+
+    conf, driver, ex0, ex1 = _cluster(
+        "wrapper", {"tpu.shuffle.partitionLocationFetchTimeoutMs": "500"}
+    )
+    try:
+        handle = BaseShuffleHandle(shuffle_id=0, num_maps=2, partitioner=HashPartitioner(2))
+        driver.register_shuffle(handle)
+        for map_id, ex in [(0, ex0), (1, ex1)]:
+            w = ex.get_writer(handle, map_id)
+            w.write(iter([(f"m{map_id}-{i}", i) for i in range(100)]))
+            w.stop(True)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with driver._lock:
+                if driver._maps_done.get(0, 0) >= 2:
+                    break
+            time.sleep(0.02)
+        ex1.stop()  # lose exec-1 and its published map output
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with driver._lock:
+                if driver._maps_done.get(0, 0) < 2:
+                    break
+            time.sleep(0.02)
+        with driver._lock:
+            assert driver._maps_done.get(0, 0) < 2  # barrier re-armed
+        reader = ex0.get_reader(handle, 0, 2)
+        with pytest.raises(MetadataFetchFailedError):
+            list(reader.read())
+    finally:
+        _stop_all(ex0, driver)
+
+
+def test_chunked_agg_poisoned_by_dirty_failed_map():
+    """Regression: a failed map task that already flushed frames into
+    the shared logs must make finalize_and_publish refuse to publish."""
+    from sparkrdma_tpu.shuffle.errors import ShuffleError
+
+    conf, driver, ex0, ex1 = _cluster(
+        "chunkedpartitionagg",
+        {"tpu.shuffle.shuffleWriteFlushSize": "4096"},  # flush early
+    )
+    try:
+        handle = BaseShuffleHandle(shuffle_id=0, num_maps=2, partitioner=HashPartitioner(1))
+        driver.register_shuffle(handle)
+        ok = ex0.get_writer(handle, 0)
+        ok.write(iter([("a", i) for i in range(50)]))
+        ok.stop(True)
+        bad = ex0.get_writer(handle, 1)
+        bad.write(iter([("b", "x" * 256) for _ in range(100)]))  # > flush size
+        bad.stop(False)  # fails after flushing frames
+        with pytest.raises(ShuffleError):
+            ex0.finalize_maps(0)
+    finally:
+        _stop_all(ex0, ex1, driver)
+
+
+def test_chunked_agg_clean_failed_map_does_not_poison():
+    """A failed map that never flushed leaves the logs publishable."""
+    conf, driver, ex0, ex1 = _cluster("chunkedpartitionagg")
+    try:
+        handle = BaseShuffleHandle(shuffle_id=0, num_maps=2, partitioner=HashPartitioner(1))
+        driver.register_shuffle(handle)
+        ok = ex0.get_writer(handle, 0)
+        ok.write(iter([("a", 1)]))
+        ok.stop(True)
+        bad = ex0.get_writer(handle, 1)
+        bad.write(iter([("b", 2)]))  # small: stays buffered, never flushed
+        bad.stop(False)
+        ex0.finalize_maps(0)  # must not raise
+    finally:
+        _stop_all(ex0, ex1, driver)

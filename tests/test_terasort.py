@@ -1,0 +1,73 @@
+"""Flagship workload: device TeraSort over the 8-device mesh.
+
+Workload-level truth per SURVEY.md §4: golden-result comparison of the
+exchange-path output vs a plain host sort (the reference validated by
+comparing RDMA-path TeraSort output to stock sort shuffle)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkrdma_tpu.models.terasort import TeraSorter
+from sparkrdma_tpu.ops.sort import merge_received, pack_by_partition, radix_partition
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+
+def test_radix_partition_ranges():
+    keys = jnp.array([0, 1 << 29, 1 << 30, 3 << 30, 0xFFFFFFFF], dtype=jnp.uint32)
+    dest = radix_partition(keys, 4)
+    assert list(np.asarray(dest)) == [0, 0, 1, 3, 3]
+
+
+def test_pack_by_partition_layout_and_overflow():
+    vals = jnp.array([10, 20, 30, 40, 50], dtype=jnp.uint32)
+    dest = jnp.array([1, 0, 1, 1, 0], dtype=jnp.int32)
+    slab, counts, overflowed = pack_by_partition(vals, dest, 2, capacity=4, fill=0)
+    assert not bool(overflowed)
+    assert list(np.asarray(counts)) == [2, 3]
+    assert list(np.asarray(slab)[0, :2]) == [20, 50]  # input order preserved
+    assert list(np.asarray(slab)[1, :3]) == [10, 30, 40]
+    _, _, overflowed = pack_by_partition(vals, dest, 2, capacity=2, fill=0)
+    assert bool(overflowed)
+
+
+def test_merge_received_masks_padding():
+    slab = jnp.array([[5, 99, 99], [3, 1, 99]], dtype=jnp.uint32)
+    counts = jnp.array([1, 2], dtype=jnp.int32)
+    merged, total = merge_received(slab, counts, 0xFFFFFFFF)
+    assert int(total) == 3
+    assert list(np.asarray(merged)[:3]) == [1, 3, 5]
+
+
+@pytest.mark.parametrize("n", [1024, 100_000])
+def test_terasort_matches_numpy(n):
+    rng = np.random.default_rng(42)
+    keys = rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    sorter = TeraSorter(make_mesh())
+    out = sorter.sort(keys)
+    np.testing.assert_array_equal(out, np.sort(keys))
+
+
+def test_terasort_skewed_keys_overflow_retry():
+    """All keys in one range: the first capacity class overflows and the
+    host retries with doubled buckets (pool-style re-rounding)."""
+    keys = np.zeros(4096, dtype=np.uint32)  # every key -> partition 0
+    sorter = TeraSorter(make_mesh(), capacity_factor=1.25)
+    out = sorter.sort(keys)
+    np.testing.assert_array_equal(out, keys)
+
+
+def test_terasort_on_2d_mesh():
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 1 << 32, size=8192, dtype=np.uint32)
+    sorter = TeraSorter(make_mesh(num_slices=2))
+    np.testing.assert_array_equal(sorter.sort(keys), np.sort(keys))
+
+
+def test_terasort_non_multiple_length():
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 1 << 32, size=1000, dtype=np.uint32)  # 1000 % 8 != 0
+    sorter = TeraSorter(make_mesh())
+    np.testing.assert_array_equal(sorter.sort(keys), np.sort(keys))
