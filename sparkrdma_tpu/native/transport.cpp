@@ -1,0 +1,920 @@
+// srt transport — the native data plane of the sparkrdma_tpu host path.
+//
+// Role: the libdisni/DiSNI equivalent of the reference (SURVEY.md §2.2).
+// The reference is inoperable without a native verbs layer doing the
+// actual per-byte work (ibv_post_send / ibv_poll_cq / connection
+// management); this library is that layer for the TPU framework's host
+// transport: a per-process endpoint ("node") with
+//
+//   - a region registry (the ProtectionDomain): mkey -> (ptr, len),
+//     served under a mutex (IbvPd.regMr analogue, RdmaBuffer.java:81-88),
+//   - an epoll event loop thread owning every socket: accepts, frame
+//     parsing, passive one-sided READ service straight out of the
+//     registry — application code never runs per served byte
+//     (IBV_WR_RDMA_READ service, RdmaChannel.java:360-393),
+//   - a completion queue the host language polls (ibv_poll_cq analogue):
+//     SEND_DONE / READ_DONE / RECV / ACCEPT / CHANNEL_DOWN,
+//   - one-sided READ: bytes stream directly into the caller-provided
+//     destination buffer as they arrive, no staging copy.
+//
+// Wire format: byte-identical to sparkrdma_tpu/transport/wire.py (all
+// big-endian), so native and pure-Python nodes interoperate:
+//   SEND      = op(1) payload_len(4) payload
+//   READ_REQ  = op(1) req_id(8) n(4) then n x [mkey(4) addr(8) len(4)]
+//   READ_RESP = op(1) req_id(8) total_len(8) payload
+//   READ_ERR  = op(1) req_id(8) msg_len(4) msg
+//   HELLO     = op(1) port(4) id_len(2) executor_id
+//   GOODBYE   = op(1)
+//
+// Threading: all public calls are thread-safe. Mutations of socket/epoll
+// state are shipped to the loop thread via an eventfd-signalled command
+// queue; the registry and completion queue have their own locks.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <array>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t OP_SEND = 1;
+constexpr uint8_t OP_READ_REQ = 2;
+constexpr uint8_t OP_READ_RESP = 3;
+constexpr uint8_t OP_READ_ERR = 4;
+constexpr uint8_t OP_HELLO = 5;
+constexpr uint8_t OP_GOODBYE = 6;
+
+constexpr uint32_t COMP_SEND_DONE = 1;
+constexpr uint32_t COMP_READ_DONE = 2;
+constexpr uint32_t COMP_RECV = 3;
+constexpr uint32_t COMP_CHANNEL_DOWN = 4;
+constexpr uint32_t COMP_ACCEPT = 5;
+
+constexpr uint32_t ST_OK = 0;
+constexpr uint32_t ST_ERR = 1;
+constexpr uint32_t ST_REMOTE_ERR = 2;
+
+inline uint16_t load_be16(const uint8_t* p) {
+  return (uint16_t(p[0]) << 8) | uint16_t(p[1]);
+}
+inline uint32_t load_be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline uint64_t load_be64(const uint8_t* p) {
+  return (uint64_t(load_be32(p)) << 32) | load_be32(p + 4);
+}
+inline void store_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+inline void store_be64(uint8_t* p, uint64_t v) {
+  store_be32(p, v >> 32);
+  store_be32(p + 4, (uint32_t)v);
+}
+
+struct Completion {
+  uint32_t kind;
+  uint32_t status;
+  uint64_t channel;
+  uint64_t wr_id;
+  void* payload;        // RECV: data; ACCEPT: executor-id string (not NUL-terminated)
+  uint64_t payload_len;
+  uint32_t aux;         // ACCEPT: peer listen port
+};
+
+struct OutBuf {
+  std::vector<uint8_t> data;
+  size_t pos = 0;
+  uint64_t wr_id = 0;    // nonzero: emit SEND_DONE when fully written
+  bool last_of_wr = false;
+};
+
+struct PendingRead {
+  uint64_t wr_id;
+  uint8_t* dst;
+  uint64_t expected;
+  uint64_t received = 0;
+};
+
+// incremental frame-parser states
+enum class RxState {
+  OP,
+  SEND_HDR, SEND_BODY,
+  READQ_HDR, READQ_BLOCKS,
+  READR_HDR, READR_BODY, READR_DRAIN,
+  READE_HDR, READE_BODY,
+  HELLO_HDR, HELLO_BODY,
+};
+
+struct Conn {
+  int fd = -1;
+  uint64_t id = 0;
+  bool hello_done = false;       // inbound conns announce themselves first
+  bool outbound = false;
+  bool down = false;
+  std::deque<OutBuf> outq;
+  bool want_write = false;
+
+  RxState st = RxState::OP;
+  uint8_t hdr[16];
+  size_t hdr_need = 0, hdr_got = 0;
+  std::vector<uint8_t> body;
+  size_t body_need = 0, body_got = 0;
+  uint64_t cur_req = 0;
+  uint64_t drain_left = 0;
+  PendingRead* cur_read = nullptr;  // owned by reads map
+
+  std::unordered_map<uint64_t, PendingRead> reads;  // req_id -> pending
+};
+
+struct Command {
+  enum Kind { ADD_CONN, SEND, READ, CLOSE_CONN, STOP } kind;
+  uint64_t channel = 0;
+  int fd = -1;
+  bool outbound = false;
+  std::vector<uint8_t> data;
+  uint64_t wr_id = 0;
+  bool last_of_wr = false;
+  // READ only: pending-read registration shipped to the loop thread,
+  // which solely owns Conn::reads (no cross-thread map access)
+  uint64_t req_id = 0;
+  uint8_t* dst = nullptr;
+  uint64_t expected = 0;
+};
+
+struct Node {
+  int listen_fd = -1;
+  int epfd = -1;
+  int evfd = -1;
+  uint16_t port = 0;
+  std::thread loop;
+  std::atomic<bool> stopping{false};
+
+  std::mutex reg_mu;
+  std::unordered_map<uint32_t, std::pair<const uint8_t*, uint64_t>> regions;
+  uint32_t next_mkey = 1;
+
+  std::mutex cq_mu;
+  std::condition_variable cq_cv;
+  std::deque<Completion> cq;
+
+  std::mutex cmd_mu;
+  std::deque<Command> cmds;
+
+  std::mutex conn_mu;  // guards id->Conn* map (loop thread owns Conn bodies)
+  std::unordered_map<uint64_t, Conn*> conns;
+  uint64_t next_conn = 1;
+
+  void post(Completion c) {
+    {
+      std::lock_guard<std::mutex> g(cq_mu);
+      cq.push_back(c);
+    }
+    cq_cv.notify_one();
+  }
+  void wake() {
+    uint64_t one = 1;
+    ssize_t r = write(evfd, &one, sizeof(one));
+    (void)r;
+  }
+  void enqueue(Command c) {
+    {
+      std::lock_guard<std::mutex> g(cmd_mu);
+      cmds.push_back(std::move(c));
+    }
+    wake();
+  }
+};
+
+int set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void arm(Node* n, Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+  ev.data.ptr = c;
+  epoll_ctl(n->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void fail_conn(Node* n, Conn* c) {
+  if (c->down) return;
+  c->down = true;
+  // fail every outstanding one-sided READ on this channel
+  for (auto& kv : c->reads) {
+    Completion comp{};
+    comp.kind = COMP_READ_DONE;
+    comp.status = ST_ERR;
+    comp.channel = c->id;
+    comp.wr_id = kv.second.wr_id;
+    n->post(comp);
+  }
+  c->reads.clear();
+  Completion comp{};
+  comp.kind = COMP_CHANNEL_DOWN;
+  comp.channel = c->id;
+  n->post(comp);
+  epoll_ctl(n->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  c->fd = -1;
+}
+
+void queue_out(Node* n, Conn* c, std::vector<uint8_t> data, uint64_t wr_id,
+               bool last) {
+  if (c->down) {
+    if (wr_id && last) {
+      Completion comp{};
+      comp.kind = COMP_SEND_DONE;
+      comp.status = ST_ERR;
+      comp.channel = c->id;
+      comp.wr_id = wr_id;
+      n->post(comp);
+    }
+    return;
+  }
+  OutBuf ob;
+  ob.data = std::move(data);
+  ob.wr_id = wr_id;
+  ob.last_of_wr = last;
+  c->outq.push_back(std::move(ob));
+  if (!c->want_write) {
+    c->want_write = true;
+    arm(n, c);
+  }
+}
+
+void flush_out(Node* n, Conn* c) {
+  while (!c->outq.empty()) {
+    OutBuf& ob = c->outq.front();
+    while (ob.pos < ob.data.size()) {
+      ssize_t w = send(c->fd, ob.data.data() + ob.pos, ob.data.size() - ob.pos,
+                       MSG_NOSIGNAL);
+      if (w > 0) {
+        ob.pos += (size_t)w;
+      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return;  // EPOLLOUT stays armed
+      } else {
+        fail_conn(n, c);
+        return;
+      }
+    }
+    if (ob.wr_id && ob.last_of_wr) {
+      Completion comp{};
+      comp.kind = COMP_SEND_DONE;
+      comp.status = ST_OK;
+      comp.channel = c->id;
+      comp.wr_id = ob.wr_id;
+      n->post(comp);
+    }
+    c->outq.pop_front();
+  }
+  if (c->want_write) {
+    c->want_write = false;
+    arm(n, c);
+  }
+}
+
+// serve a one-sided READ_REQ entirely in native code: resolve each
+// (mkey, addr, len) block against the registry and queue the response
+void serve_read(Node* n, Conn* c, uint64_t req_id,
+                const std::vector<std::array<uint64_t, 3>>& blocks) {
+  uint64_t total = 0;
+  std::vector<std::pair<const uint8_t*, uint64_t>> views;
+  {
+    std::lock_guard<std::mutex> g(n->reg_mu);
+    for (auto& b : blocks) {
+      auto it = n->regions.find((uint32_t)b[0]);
+      if (it == n->regions.end() || b[1] + b[2] > it->second.second) {
+        std::string msg = "region resolve failed (mkey " +
+                          std::to_string(b[0]) + ")";
+        std::vector<uint8_t> out(1 + 8 + 4 + msg.size());
+        out[0] = OP_READ_ERR;
+        store_be64(&out[1], req_id);
+        store_be32(&out[9], (uint32_t)msg.size());
+        memcpy(&out[13], msg.data(), msg.size());
+        queue_out(n, c, std::move(out), 0, false);
+        return;
+      }
+      views.emplace_back(it->second.first + b[1], b[2]);
+      total += b[2];
+    }
+    // copy under the registry lock: a concurrent dereg cannot race the
+    // memcpy (the reference relies on MR invalidation ordering instead)
+    std::vector<uint8_t> out(1 + 8 + 8 + total);
+    out[0] = OP_READ_RESP;
+    store_be64(&out[1], req_id);
+    store_be64(&out[9], total);
+    size_t off = 17;
+    for (auto& v : views) {
+      memcpy(&out[off], v.first, v.second);
+      off += v.second;
+    }
+    queue_out(n, c, std::move(out), 0, false);
+  }
+}
+
+void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len);
+
+// consume as many bytes as the state machine wants from [data, data+len)
+size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
+  size_t used = 0;
+  while (used < len && !c->down) {
+    switch (c->st) {
+      case RxState::OP: {
+        uint8_t op = data[used++];
+        c->hdr_got = 0;
+        switch (op) {
+          case OP_SEND: c->st = RxState::SEND_HDR; c->hdr_need = 4; break;
+          case OP_READ_REQ: c->st = RxState::READQ_HDR; c->hdr_need = 12; break;
+          case OP_READ_RESP: c->st = RxState::READR_HDR; c->hdr_need = 16; break;
+          case OP_READ_ERR: c->st = RxState::READE_HDR; c->hdr_need = 12; break;
+          case OP_HELLO: c->st = RxState::HELLO_HDR; c->hdr_need = 6; break;
+          case OP_GOODBYE: fail_conn(n, c); return used;
+          default: fail_conn(n, c); return used;
+        }
+        break;
+      }
+      case RxState::SEND_HDR:
+      case RxState::READQ_HDR:
+      case RxState::READR_HDR:
+      case RxState::READE_HDR:
+      case RxState::HELLO_HDR: {
+        size_t take = std::min(len - used, c->hdr_need - c->hdr_got);
+        memcpy(c->hdr + c->hdr_got, data + used, take);
+        c->hdr_got += take;
+        used += take;
+        if (c->hdr_got < c->hdr_need) break;
+        if (c->st == RxState::SEND_HDR) {
+          c->body_need = load_be32(c->hdr);
+          c->body.resize(c->body_need);
+          c->body_got = 0;
+          c->st = c->body_need ? RxState::SEND_BODY : RxState::OP;
+          if (!c->body_need) {
+            Completion comp{};
+            comp.kind = COMP_RECV;
+            comp.channel = c->id;
+            comp.payload = nullptr;
+            comp.payload_len = 0;
+            n->post(comp);
+          }
+        } else if (c->st == RxState::READQ_HDR) {
+          c->cur_req = load_be64(c->hdr);
+          c->body_need = (size_t)load_be32(c->hdr + 8) * 16;
+          c->body.resize(c->body_need);
+          c->body_got = 0;
+          c->st = RxState::READQ_BLOCKS;
+        } else if (c->st == RxState::READR_HDR) {
+          uint64_t req = load_be64(c->hdr);
+          uint64_t total = load_be64(c->hdr + 8);
+          auto it = c->reads.find(req);
+          if (it == c->reads.end() || it->second.expected != total) {
+            // unknown or mismatched: drain to keep framing intact
+            if (it != c->reads.end()) {
+              Completion comp{};
+              comp.kind = COMP_READ_DONE;
+              comp.status = ST_ERR;
+              comp.channel = c->id;
+              comp.wr_id = it->second.wr_id;
+              n->post(comp);
+              c->reads.erase(it);
+            }
+            c->drain_left = total;
+            c->st = total ? RxState::READR_DRAIN : RxState::OP;
+          } else {
+            c->cur_req = req;
+            c->cur_read = &it->second;
+            c->st = total ? RxState::READR_BODY : RxState::OP;
+            if (!total) {
+              Completion comp{};
+              comp.kind = COMP_READ_DONE;
+              comp.status = ST_OK;
+              comp.channel = c->id;
+              comp.wr_id = it->second.wr_id;
+              n->post(comp);
+              c->reads.erase(it);
+              c->cur_read = nullptr;
+            }
+          }
+        } else if (c->st == RxState::READE_HDR) {
+          c->cur_req = load_be64(c->hdr);
+          c->body_need = load_be32(c->hdr + 8);
+          c->body.resize(c->body_need);
+          c->body_got = 0;
+          c->st = c->body_need ? RxState::READE_BODY : RxState::OP;
+        } else {  // HELLO_HDR
+          c->body_need = load_be16(c->hdr + 4);
+          c->body.resize(c->body_need);
+          c->body_got = 0;
+          c->st = RxState::HELLO_BODY;
+          if (!c->body_need) {
+            // zero-length id: still emit ACCEPT
+            Completion comp{};
+            comp.kind = COMP_ACCEPT;
+            comp.channel = c->id;
+            comp.aux = load_be32(c->hdr);
+            comp.payload = nullptr;
+            comp.payload_len = 0;
+            n->post(comp);
+            c->hello_done = true;
+            c->st = RxState::OP;
+          }
+        }
+        break;
+      }
+      case RxState::SEND_BODY:
+      case RxState::READQ_BLOCKS:
+      case RxState::READE_BODY:
+      case RxState::HELLO_BODY: {
+        size_t take = std::min(len - used, c->body_need - c->body_got);
+        memcpy(c->body.data() + c->body_got, data + used, take);
+        c->body_got += take;
+        used += take;
+        if (c->body_got < c->body_need) break;
+        handle_frame_ingest(n, c, c->body.data(), c->body.size());
+        c->st = RxState::OP;
+        break;
+      }
+      case RxState::READR_BODY: {
+        PendingRead* pr = c->cur_read;
+        size_t take = std::min<uint64_t>(len - used, pr->expected - pr->received);
+        memcpy(pr->dst + pr->received, data + used, take);
+        pr->received += take;
+        used += take;
+        if (pr->received == pr->expected) {
+          Completion comp{};
+          comp.kind = COMP_READ_DONE;
+          comp.status = ST_OK;
+          comp.channel = c->id;
+          comp.wr_id = pr->wr_id;
+          n->post(comp);
+          c->reads.erase(c->cur_req);
+          c->cur_read = nullptr;
+          c->st = RxState::OP;
+        }
+        break;
+      }
+      case RxState::READR_DRAIN: {
+        size_t take = std::min<uint64_t>(len - used, c->drain_left);
+        c->drain_left -= take;
+        used += take;
+        if (!c->drain_left) c->st = RxState::OP;
+        break;
+      }
+    }
+  }
+  return used;
+}
+
+// completed-body dispatch for SEND / READ_REQ / READ_ERR / HELLO
+void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
+  switch (c->st) {
+    case RxState::SEND_BODY: {
+      void* p = malloc(len ? len : 1);
+      memcpy(p, data, len);
+      Completion comp{};
+      comp.kind = COMP_RECV;
+      comp.channel = c->id;
+      comp.payload = p;
+      comp.payload_len = len;
+      n->post(comp);
+      break;
+    }
+    case RxState::READQ_BLOCKS: {
+      std::vector<std::array<uint64_t, 3>> blocks(len / 16);
+      for (size_t i = 0; i < blocks.size(); i++) {
+        const uint8_t* b = data + i * 16;
+        blocks[i] = {load_be32(b), load_be64(b + 4), load_be32(b + 12)};
+      }
+      serve_read(n, c, c->cur_req, blocks);
+      break;
+    }
+    case RxState::READE_BODY: {
+      auto it = c->reads.find(c->cur_req);
+      if (it != c->reads.end()) {
+        void* p = malloc(len ? len : 1);
+        memcpy(p, data, len);
+        Completion comp{};
+        comp.kind = COMP_READ_DONE;
+        comp.status = ST_REMOTE_ERR;
+        comp.channel = c->id;
+        comp.wr_id = it->second.wr_id;
+        comp.payload = p;
+        comp.payload_len = len;
+        n->post(comp);
+        c->reads.erase(it);
+      }
+      break;
+    }
+    case RxState::HELLO_BODY: {
+      void* p = malloc(len ? len : 1);
+      memcpy(p, data, len);
+      Completion comp{};
+      comp.kind = COMP_ACCEPT;
+      comp.channel = c->id;
+      comp.aux = load_be32(c->hdr);
+      comp.payload = p;
+      comp.payload_len = len;
+      n->post(comp);
+      c->hello_done = true;
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+void loop_main(Node* n) {
+  epoll_event evs[64];
+  uint8_t buf[1 << 16];
+  while (true) {
+    int k = epoll_wait(n->epfd, evs, 64, 100);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < k; i++) {
+      void* tag = evs[i].data.ptr;
+      if (tag == &n->evfd) {
+        uint64_t junk;
+        ssize_t r = read(n->evfd, &junk, sizeof(junk));
+        (void)r;
+        // drain commands
+        while (true) {
+          Command cmd;
+          {
+            std::lock_guard<std::mutex> g(n->cmd_mu);
+            if (n->cmds.empty()) break;
+            cmd = std::move(n->cmds.front());
+            n->cmds.pop_front();
+          }
+          if (cmd.kind == Command::STOP) return;
+          Conn* c = nullptr;
+          {
+            std::lock_guard<std::mutex> g(n->conn_mu);
+            auto it = n->conns.find(cmd.channel);
+            if (it != n->conns.end()) c = it->second;
+          }
+          if (cmd.kind == Command::ADD_CONN && c) {
+            epoll_event ev{};
+            ev.events = EPOLLIN;
+            ev.data.ptr = c;
+            epoll_ctl(n->epfd, EPOLL_CTL_ADD, c->fd, &ev);
+          } else if (cmd.kind == Command::SEND && c) {
+            queue_out(n, c, std::move(cmd.data), cmd.wr_id, cmd.last_of_wr);
+            if (!c->down) flush_out(n, c);
+          } else if (cmd.kind == Command::SEND && !c) {
+            if (cmd.wr_id && cmd.last_of_wr) {
+              Completion comp{};
+              comp.kind = COMP_SEND_DONE;
+              comp.status = ST_ERR;
+              comp.channel = cmd.channel;
+              comp.wr_id = cmd.wr_id;
+              n->post(comp);
+            }
+          } else if (cmd.kind == Command::READ) {
+            if (!c || c->down) {
+              Completion comp{};
+              comp.kind = COMP_READ_DONE;
+              comp.status = ST_ERR;
+              comp.channel = cmd.channel;
+              comp.wr_id = cmd.wr_id;
+              n->post(comp);
+            } else {
+              PendingRead pr;
+              pr.wr_id = cmd.wr_id;
+              pr.dst = cmd.dst;
+              pr.expected = cmd.expected;
+              c->reads.emplace(cmd.req_id, pr);
+              queue_out(n, c, std::move(cmd.data), 0, false);
+              if (!c->down) flush_out(n, c);
+            }
+          } else if (cmd.kind == Command::CLOSE_CONN && c) {
+            // flush what we can, then drop
+            if (!c->down) flush_out(n, c);
+            fail_conn(n, c);
+          }
+        }
+        continue;
+      }
+      if (tag == &n->listen_fd) {
+        while (true) {
+          int fd = accept4(n->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (fd < 0) break;
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn* c = new Conn();
+          c->fd = fd;
+          {
+            std::lock_guard<std::mutex> g(n->conn_mu);
+            c->id = n->next_conn++;
+            n->conns[c->id] = c;
+          }
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.ptr = c;
+          epoll_ctl(n->epfd, EPOLL_CTL_ADD, fd, &ev);
+        }
+        continue;
+      }
+      Conn* c = (Conn*)tag;
+      if (c->down) continue;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        fail_conn(n, c);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) flush_out(n, c);
+      if (c->down) continue;
+      if (evs[i].events & EPOLLIN) {
+        while (true) {
+          ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+          if (r > 0) {
+            size_t used = 0;
+            while (used < (size_t)r && !c->down)
+              used += ingest(n, c, buf + used, (size_t)r - used);
+            if (c->down) break;
+          } else if (r == 0) {
+            fail_conn(n, c);
+            break;
+          } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            break;
+          } else {
+            fail_conn(n, c);
+            break;
+          }
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+struct srt_comp_c {
+  uint32_t kind;
+  uint32_t status;
+  uint64_t channel;
+  uint64_t wr_id;
+  void* payload;
+  uint64_t payload_len;
+  uint32_t aux;
+  uint32_t _pad;
+};
+
+void* srt_node_create(const char* host, uint16_t base_port, int max_retries) {
+  Node* n = new Node();
+  n->epfd = epoll_create1(0);
+  n->evfd = eventfd(0, EFD_NONBLOCK);
+  // bind with port retries (RdmaNode.java:75-97)
+  for (int attempt = 0; attempt < max_retries; attempt++) {
+    uint16_t port = base_port == 0 ? 0 : base_port + attempt;
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    inet_pton(AF_INET, host, &addr.sin_addr);
+    if (bind(fd, (sockaddr*)&addr, sizeof(addr)) == 0 && listen(fd, 128) == 0) {
+      set_nonblock(fd);
+      n->listen_fd = fd;
+      socklen_t alen = sizeof(addr);
+      getsockname(fd, (sockaddr*)&addr, &alen);
+      n->port = ntohs(addr.sin_port);
+      break;
+    }
+    close(fd);
+  }
+  if (n->listen_fd < 0) {
+    close(n->epfd);
+    close(n->evfd);
+    delete n;
+    return nullptr;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = &n->listen_fd;
+  epoll_ctl(n->epfd, EPOLL_CTL_ADD, n->listen_fd, &ev);
+  ev.events = EPOLLIN;
+  ev.data.ptr = &n->evfd;
+  epoll_ctl(n->epfd, EPOLL_CTL_ADD, n->evfd, &ev);
+  n->loop = std::thread(loop_main, n);
+  return n;
+}
+
+uint16_t srt_node_port(void* np) { return ((Node*)np)->port; }
+
+// -- region registry (ProtectionDomain) ---------------------------------
+uint32_t srt_reg(void* np, const void* ptr, uint64_t len) {
+  Node* n = (Node*)np;
+  std::lock_guard<std::mutex> g(n->reg_mu);
+  uint32_t mkey = n->next_mkey++;
+  n->regions[mkey] = {(const uint8_t*)ptr, len};
+  return mkey;
+}
+
+int srt_dereg(void* np, uint32_t mkey) {
+  Node* n = (Node*)np;
+  std::lock_guard<std::mutex> g(n->reg_mu);
+  return n->regions.erase(mkey) ? 0 : -1;
+}
+
+uint64_t srt_region_count(void* np) {
+  Node* n = (Node*)np;
+  std::lock_guard<std::mutex> g(n->reg_mu);
+  return n->regions.size();
+}
+
+// -- channels -----------------------------------------------------------
+// connect + send the HELLO preamble; blocking in the caller's thread
+// (the connect retry/timeout policy lives in the host language, like
+// RdmaNode.getRdmaChannel's retry loop)
+uint64_t srt_connect(void* np, const char* host, uint16_t port,
+                     uint16_t my_port, const char* my_id, int timeout_ms) {
+  Node* n = (Node*)np;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    hostent* he = gethostbyname(host);
+    if (!he) { close(fd); return 0; }
+    memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+  }
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return 0;
+  }
+  size_t idlen = strlen(my_id);
+  std::vector<uint8_t> hello(1 + 4 + 2 + idlen);
+  hello[0] = OP_HELLO;
+  store_be32(&hello[1], my_port);
+  hello[5] = idlen >> 8;
+  hello[6] = idlen & 0xff;
+  memcpy(&hello[7], my_id, idlen);
+  size_t off = 0;
+  while (off < hello.size()) {
+    ssize_t w = send(fd, hello.data() + off, hello.size() - off, MSG_NOSIGNAL);
+    if (w <= 0) { close(fd); return 0; }
+    off += (size_t)w;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  set_nonblock(fd);
+  Conn* c = new Conn();
+  c->fd = fd;
+  c->outbound = true;
+  uint64_t id;
+  {
+    std::lock_guard<std::mutex> g(n->conn_mu);
+    id = n->next_conn++;
+    c->id = id;
+    n->conns[id] = c;
+  }
+  Command cmd;
+  cmd.kind = Command::ADD_CONN;
+  cmd.channel = id;
+  n->enqueue(std::move(cmd));
+  return id;
+}
+
+// post one SEND frame; when wr_id != 0 and last != 0, a SEND_DONE
+// completion fires once the bytes hit the socket
+int srt_post_send(void* np, uint64_t channel, const void* data, uint64_t len,
+                  uint64_t wr_id, int last) {
+  Node* n = (Node*)np;
+  std::vector<uint8_t> frame(1 + 4 + len);
+  frame[0] = OP_SEND;
+  store_be32(&frame[1], (uint32_t)len);
+  memcpy(&frame[5], data, len);
+  Command cmd;
+  cmd.kind = Command::SEND;
+  cmd.channel = channel;
+  cmd.data = std::move(frame);
+  cmd.wr_id = wr_id;
+  cmd.last_of_wr = last != 0;
+  n->enqueue(std::move(cmd));
+  return 0;
+}
+
+// post a one-sided READ of n_blocks remote (mkey, addr, len) triples;
+// bytes stream straight into dst; READ_DONE(wr_id) on completion
+int srt_post_read(void* np, uint64_t channel, uint64_t wr_id, void* dst,
+                  const uint64_t* blocks, uint32_t n_blocks) {
+  Node* n = (Node*)np;
+  uint64_t total = 0;
+  std::vector<uint8_t> frame(1 + 8 + 4 + (size_t)n_blocks * 16);
+  frame[0] = OP_READ_REQ;
+  store_be32(&frame[9], n_blocks);
+  for (uint32_t i = 0; i < n_blocks; i++) {
+    uint8_t* b = &frame[13 + (size_t)i * 16];
+    store_be32(b, (uint32_t)blocks[i * 3]);
+    store_be64(b + 4, blocks[i * 3 + 1]);
+    store_be32(b + 12, (uint32_t)blocks[i * 3 + 2]);
+    total += blocks[i * 3 + 2];
+  }
+  static std::atomic<uint64_t> next_req{1};
+  uint64_t req_id = next_req.fetch_add(1);
+  store_be64(&frame[1], req_id);
+  Command cmd;
+  cmd.kind = Command::READ;
+  cmd.channel = channel;
+  cmd.data = std::move(frame);
+  cmd.wr_id = wr_id;
+  cmd.req_id = req_id;
+  cmd.dst = (uint8_t*)dst;
+  cmd.expected = total;
+  n->enqueue(std::move(cmd));
+  return 0;
+}
+
+int srt_close_channel(void* np, uint64_t channel) {
+  Node* n = (Node*)np;
+  Command cmd;
+  cmd.kind = Command::CLOSE_CONN;
+  cmd.channel = channel;
+  n->enqueue(std::move(cmd));
+  return 0;
+}
+
+// -- completion queue ---------------------------------------------------
+int srt_poll_cq(void* np, srt_comp_c* out, int max, int timeout_ms) {
+  Node* n = (Node*)np;
+  std::unique_lock<std::mutex> lk(n->cq_mu);
+  if (n->cq.empty()) {
+    n->cq_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return !n->cq.empty(); });
+  }
+  int k = 0;
+  while (k < max && !n->cq.empty()) {
+    Completion c = n->cq.front();
+    n->cq.pop_front();
+    out[k].kind = c.kind;
+    out[k].status = c.status;
+    out[k].channel = c.channel;
+    out[k].wr_id = c.wr_id;
+    out[k].payload = c.payload;
+    out[k].payload_len = c.payload_len;
+    out[k].aux = c.aux;
+    k++;
+  }
+  return k;
+}
+
+void srt_free_payload(void* p) { free(p); }
+
+void srt_node_stop(void* np) {
+  Node* n = (Node*)np;
+  bool was = n->stopping.exchange(true);
+  if (was) return;
+  Command cmd;
+  cmd.kind = Command::STOP;
+  n->enqueue(std::move(cmd));
+  n->loop.join();
+  close(n->listen_fd);
+  {
+    std::lock_guard<std::mutex> g(n->conn_mu);
+    for (auto& kv : n->conns) {
+      if (kv.second->fd >= 0) close(kv.second->fd);
+      delete kv.second;
+    }
+    n->conns.clear();
+  }
+  close(n->epfd);
+  close(n->evfd);
+  {
+    std::lock_guard<std::mutex> g(n->cq_mu);
+    for (auto& c : n->cq)
+      if (c.payload) free(c.payload);
+    n->cq.clear();
+  }
+  delete n;
+}
+
+}  // extern "C"

@@ -1,0 +1,112 @@
+"""ctypes binding for the native transport data plane (transport.cpp).
+
+Builds on first use with g++ (cached next to the source), exactly like
+the arena binding. Falls back to None when the toolchain is missing —
+callers then use the pure-Python transport (same wire format, same
+semantics, slower per-byte path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "transport.cpp")
+_SO = os.path.join(_HERE, "_libsrt_transport.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+# completion kinds (transport.cpp)
+COMP_SEND_DONE = 1
+COMP_READ_DONE = 2
+COMP_RECV = 3
+COMP_CHANNEL_DOWN = 4
+COMP_ACCEPT = 5
+
+ST_OK = 0
+ST_ERR = 1
+ST_REMOTE_ERR = 2
+
+
+class SrtComp(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_uint32),
+        ("status", ctypes.c_uint32),
+        ("channel", ctypes.c_uint64),
+        ("wr_id", ctypes.c_uint64),
+        ("payload", ctypes.c_void_p),
+        ("payload_len", ctypes.c_uint64),
+        ("aux", ctypes.c_uint32),
+        ("_pad", ctypes.c_uint32),
+    ]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                        "-pthread", "-o", _SO, _SRC,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError):
+            _build_failed = True
+            return None
+        lib.srt_node_create.restype = ctypes.c_void_p
+        lib.srt_node_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+        lib.srt_node_port.restype = ctypes.c_uint16
+        lib.srt_node_port.argtypes = [ctypes.c_void_p]
+        lib.srt_reg.restype = ctypes.c_uint32
+        lib.srt_reg.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.srt_dereg.restype = ctypes.c_int
+        lib.srt_dereg.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.srt_region_count.restype = ctypes.c_uint64
+        lib.srt_region_count.argtypes = [ctypes.c_void_p]
+        lib.srt_connect.restype = ctypes.c_uint64
+        lib.srt_connect.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
+            ctypes.c_uint16, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.srt_post_send.restype = ctypes.c_int
+        lib.srt_post_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.srt_post_read.restype = ctypes.c_int
+        lib.srt_post_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
+        ]
+        lib.srt_close_channel.restype = ctypes.c_int
+        lib.srt_close_channel.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.srt_poll_cq.restype = ctypes.c_int
+        lib.srt_poll_cq.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(SrtComp), ctypes.c_int, ctypes.c_int,
+        ]
+        lib.srt_free_payload.argtypes = [ctypes.c_void_p]
+        lib.srt_node_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

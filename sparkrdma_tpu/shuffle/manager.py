@@ -37,7 +37,7 @@ from sparkrdma_tpu.rpc import (
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
 from sparkrdma_tpu.shuffle.stats import ShuffleReaderStats
-from sparkrdma_tpu.transport import FnListener, TpuNode
+from sparkrdma_tpu.transport import FnListener, TpuNode, create_node
 from sparkrdma_tpu.utils.config import ShuffleWriterMethod, TpuShuffleConf
 
 logger = logging.getLogger(__name__)
@@ -85,7 +85,7 @@ class TpuShuffleManager:
         if is_driver:
             # driver starts its node eagerly and records the negotiated
             # port for executors (:180-184)
-            self.node = TpuNode(
+            self.node = create_node(
                 conf,
                 host,
                 is_executor=False,
@@ -112,7 +112,7 @@ class TpuShuffleManager:
         with self._node_lock:
             if self.node is not None:
                 return
-            node = TpuNode(
+            node = create_node(
                 self.conf,
                 self.host,
                 is_executor=True,

@@ -2,4 +2,36 @@ from sparkrdma_tpu.transport.completion import CompletionListener, FnListener
 from sparkrdma_tpu.transport.channel import TpuChannel, ChannelError
 from sparkrdma_tpu.transport.node import TpuNode
 
-__all__ = ["CompletionListener", "FnListener", "TpuChannel", "ChannelError", "TpuNode"]
+
+def create_node(conf, host, is_executor, executor_id, recv_listener=None,
+                peer_lost_listener=None):
+    """Node factory honoring ``tpu.shuffle.transport`` (python | native).
+
+    Native (C++ epoll data plane) silently falls back to the Python
+    transport when the toolchain is unavailable — same wire format."""
+    if conf.transport == "native":
+        from sparkrdma_tpu.native.transport_lib import available
+
+        if available():
+            from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+            return NativeTpuNode(
+                conf, host, is_executor, executor_id,
+                recv_listener=recv_listener,
+                peer_lost_listener=peer_lost_listener,
+            )
+    return TpuNode(
+        conf, host, is_executor, executor_id,
+        recv_listener=recv_listener,
+        peer_lost_listener=peer_lost_listener,
+    )
+
+
+__all__ = [
+    "CompletionListener",
+    "FnListener",
+    "TpuChannel",
+    "ChannelError",
+    "TpuNode",
+    "create_node",
+]

@@ -1,0 +1,456 @@
+"""NativeTpuNode / NativeTpuChannel — host transport over the C++ data plane.
+
+Same public surface as the pure-Python :class:`TpuNode`/:class:`TpuChannel`
+(node.py / channel.py) and the same wire format, but every per-byte
+operation — frame parsing, the passive one-sided READ service, payload
+streaming into destination buffers, socket IO — runs inside
+``transport.cpp``'s epoll loop. Python keeps orchestration only:
+channel caching, retry policy, listener dispatch (one CQ-poll thread
+per node, the RdmaThread analogue pinned to ``srt_poll_cq``).
+
+This is the framework's libdisni equivalent (SURVEY.md §2.2): the
+reference's JVM held the same division — Scala/Java orchestration above,
+native verbs doing the bytes below. Selected via
+``tpu.shuffle.transport = native`` (default ``python``); both transports
+interoperate on the wire, so a cluster can mix them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
+from sparkrdma_tpu.native import transport_lib as tl
+from sparkrdma_tpu.transport.channel import ChannelError
+from sparkrdma_tpu.transport.completion import CompletionListener
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+logger = logging.getLogger(__name__)
+
+
+def _addr_of(view) -> int:
+    """Raw address of a buffer-protocol object without copying (works
+    for read-only buffers too, unlike ctypes.from_buffer)."""
+    return np.frombuffer(view, dtype=np.uint8).ctypes.data
+
+
+class NativeProtectionDomain:
+    """PD over the native region registry.
+
+    ``register`` inserts the region into the C++ registry (so remote
+    one-sided READs are served entirely natively) and mirrors it in a
+    Python dict so local consumers can still ``resolve`` views."""
+
+    def __init__(self, node: "NativeTpuNode"):
+        self._node = node
+        self._mirror: Dict[int, memoryview] = {}
+        self._lock = threading.Lock()
+
+    def register(self, view: memoryview) -> int:
+        np_handle = self._node._np
+        if not np_handle:
+            raise RuntimeError("native node stopped; cannot register regions")
+        mkey = tl.load().srt_reg(np_handle, _addr_of(view), len(view))
+        with self._lock:
+            self._mirror[mkey] = view
+        return mkey
+
+    def deregister(self, mkey: int) -> None:
+        np_handle = self._node._np
+        if np_handle:
+            tl.load().srt_dereg(np_handle, mkey)
+        with self._lock:
+            self._mirror.pop(mkey, None)
+
+    def resolve(self, mkey: int, offset: int, length: int) -> memoryview:
+        from sparkrdma_tpu.memory.registry import RegionError
+
+        with self._lock:
+            view = self._mirror.get(mkey)
+        if view is None:
+            raise RegionError(f"unknown mkey {mkey}")
+        if offset < 0 or length < 0 or offset + length > len(view):
+            raise RegionError(
+                f"resolve out of bounds: mkey {mkey} [{offset}, {offset + length}) "
+                f"in region of {len(view)}"
+            )
+        return view[offset : offset + length]
+
+    def region_count(self) -> int:
+        with self._lock:
+            return len(self._mirror)
+
+    def dealloc(self) -> None:
+        with self._lock:
+            keys = list(self._mirror.keys())
+            self._mirror.clear()
+        lib = tl.load()
+        if lib is not None and self._node._np:
+            for mkey in keys:
+                lib.srt_dereg(self._node._np, mkey)
+
+
+class NativeTpuChannel:
+    """Handle to one native connection (id-based)."""
+
+    def __init__(self, node: "NativeTpuNode", channel_id: int, peer_desc: str):
+        self._node = node
+        self.channel_id = channel_id
+        self.peer_desc = peer_desc
+        self._dead = threading.Event()
+
+    # -- verb API (parity with TpuChannel) -----------------------------
+    def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
+        self._node._post_send(self, listener, segments)
+
+    def read_in_queue(
+        self,
+        listener: CompletionListener,
+        dst_views: List[memoryview],
+        blocks: List[Tuple[int, int, int]],
+    ) -> None:
+        total = sum(b[2] for b in blocks)
+        if sum(len(v) for v in dst_views) != total:
+            raise ValueError("destination size != total remote block length")
+        self._node._post_read(self, listener, dst_views, blocks)
+
+    @property
+    def is_connected(self) -> bool:
+        return not self._dead.is_set()
+
+    def stop(self) -> None:
+        self._node._close_channel(self)
+
+
+class NativeTpuNode:
+    """Per-process endpoint over the native event loop (TpuNode parity)."""
+
+    def __init__(
+        self,
+        conf: TpuShuffleConf,
+        host: str,
+        is_executor: bool,
+        executor_id: str,
+        recv_listener: Optional[Callable] = None,
+        peer_lost_listener: Optional[Callable[[str], None]] = None,
+    ):
+        lib = tl.load()
+        if lib is None:
+            raise ChannelError("native transport unavailable (g++ build failed)")
+        self._lib = lib
+        self.conf = conf
+        self.host = host
+        self.is_executor = is_executor
+        self.executor_id = executor_id
+        self._recv_listener = recv_listener
+        self._peer_lost_listener = peer_lost_listener
+
+        base_port = conf.executor_port if is_executor else conf.driver_port
+        self._np = lib.srt_node_create(
+            host.encode(), base_port, conf.port_max_retries
+        )
+        if not self._np:
+            raise ChannelError("could not bind a listener port (native)")
+        self.port = lib.srt_node_port(self._np)
+
+        self.pd = NativeProtectionDomain(self)
+        self.buffer_manager = TpuBufferManager(
+            self.pd,
+            is_executor=is_executor,
+            max_agg_block=conf.max_agg_block,
+            max_agg_prealloc=conf.max_agg_prealloc,
+        )
+
+        self._channels: Dict[int, NativeTpuChannel] = {}  # id -> handle
+        self._active: Dict[Tuple[str, int], NativeTpuChannel] = {}
+        self._passive: Dict[str, NativeTpuChannel] = {}  # peer executor_id
+        self._peer_of_channel: Dict[int, str] = {}
+        self._connect_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._lock = threading.Lock()
+
+        # outstanding work requests: wr_id -> (listener, keepalive)
+        self._wrs: Dict[int, Tuple[CompletionListener, object]] = {}
+        self._next_wr = 1
+
+        self._stopped = threading.Event()
+        self._cq_thread = threading.Thread(
+            target=self._poll_loop, name=f"srt-cq-{executor_id}", daemon=True
+        )
+        self._cq_thread.start()
+        logger.info(
+            "NativeTpuNode %s listening on %s:%d (%s)",
+            executor_id, host, self.port,
+            "executor" if is_executor else "driver",
+        )
+
+    # ------------------------------------------------------------------
+    # verb posting
+    # ------------------------------------------------------------------
+    def _alloc_wr(self, listener: CompletionListener, keepalive=None) -> int:
+        with self._lock:
+            wr = self._next_wr
+            self._next_wr += 1
+            self._wrs[wr] = (listener, keepalive)
+        return wr
+
+    def _post_send(self, ch: NativeTpuChannel, listener, segments: Sequence[bytes]) -> None:
+        if ch._dead.is_set():
+            if listener:
+                listener.on_failure(ChannelError(f"channel {ch.peer_desc} is down"))
+            return
+        wr = self._alloc_wr(listener)
+        n = len(segments)
+        for i, seg in enumerate(segments):
+            seg = bytes(seg)
+            # only the last frame of the batch is signalled (the
+            # reference signals only the last WR of a list, :383-390)
+            self._lib.srt_post_send(
+                self._np, ch.channel_id, seg, len(seg),
+                wr if i == n - 1 else 0, 1 if i == n - 1 else 0,
+            )
+        if n == 0:
+            self._complete_wr(wr, None, None)
+
+    def _post_read(self, ch, listener, dst_views: List[memoryview], blocks) -> None:
+        if ch._dead.is_set():
+            if listener:
+                listener.on_failure(ChannelError(f"channel {ch.peer_desc} is down"))
+            return
+        # pair destinations with blocks 1:1 where lengths align (the
+        # fetcher always does); otherwise stage contiguously and scatter
+        aligned = len(dst_views) == len(blocks) and all(
+            len(v) == b[2] for v, b in zip(dst_views, blocks)
+        )
+        if aligned and len(blocks) > 0:
+            remaining = [len(blocks)]
+            failed = [False]
+            lock = threading.Lock()
+
+            def sub_listener(i):
+                def ok(_):
+                    with lock:
+                        remaining[0] -= 1
+                        done = remaining[0] == 0 and not failed[0]
+                    if done and listener:
+                        listener.on_success(None)
+
+                def err(e):
+                    with lock:
+                        first = not failed[0]
+                        failed[0] = True
+                    if first and listener:
+                        listener.on_failure(e)
+
+                from sparkrdma_tpu.transport.completion import FnListener
+
+                return FnListener(ok, err)
+
+            for i, (view, block) in enumerate(zip(dst_views, blocks)):
+                arr = (ctypes.c_uint64 * 3)(block[0], block[1], block[2])
+                wr = self._alloc_wr(sub_listener(i), keepalive=view)
+                self._lib.srt_post_read(
+                    self._np, ch.channel_id, wr, _addr_of(view), arr, 1
+                )
+            return
+        # general case: one staging buffer, scatter on completion
+        total = sum(b[2] for b in blocks)
+        staging = np.empty((total,), dtype=np.uint8)
+
+        def scatter(_):
+            off = 0
+            for view in dst_views:
+                n = len(view)
+                view[:] = staging[off : off + n].tobytes()
+                off += n
+            if listener:
+                listener.on_success(None)
+
+        from sparkrdma_tpu.transport.completion import FnListener
+
+        wr = self._alloc_wr(
+            FnListener(scatter, listener.on_failure if listener else None),
+            keepalive=staging,
+        )
+        flat = (ctypes.c_uint64 * (3 * len(blocks)))()
+        for i, b in enumerate(blocks):
+            flat[3 * i], flat[3 * i + 1], flat[3 * i + 2] = b
+        self._lib.srt_post_read(
+            self._np, ch.channel_id, wr, staging.ctypes.data, flat, len(blocks)
+        )
+
+    def _complete_wr(self, wr_id: int, payload, error: Optional[Exception]) -> None:
+        with self._lock:
+            entry = self._wrs.pop(wr_id, None)
+        if entry is None:
+            return
+        listener, _keep = entry
+        if listener is None:
+            return
+        try:
+            if error is None:
+                listener.on_success(payload)
+            else:
+                listener.on_failure(error)
+        except Exception:
+            logger.exception("completion listener raised")
+
+    # ------------------------------------------------------------------
+    # CQ poll loop (RdmaThread analogue)
+    # ------------------------------------------------------------------
+    def _poll_loop(self) -> None:
+        comps = (tl.SrtComp * 64)()
+        while not self._stopped.is_set():
+            k = self._lib.srt_poll_cq(self._np, comps, 64, 100)
+            for i in range(k):
+                c = comps[i]
+                try:
+                    self._dispatch(c)
+                except Exception:
+                    logger.exception("error dispatching native completion")
+                finally:
+                    if c.payload:
+                        self._lib.srt_free_payload(c.payload)
+
+    def _dispatch(self, c: tl.SrtComp) -> None:
+        if c.kind == tl.COMP_ACCEPT:
+            peer_id = (
+                ctypes.string_at(c.payload, c.payload_len).decode("utf-8")
+                if c.payload
+                else ""
+            )
+            ch = NativeTpuChannel(self, c.channel, f"{peer_id}:{c.aux}")
+            with self._lock:
+                self._channels[c.channel] = ch
+                stale = self._passive.get(peer_id)
+                self._passive[peer_id] = ch
+                self._peer_of_channel[c.channel] = peer_id
+            if stale is not None and stale.is_connected:
+                logger.info("replacing stale passive channel for %s", peer_id)
+                stale.stop()
+            return
+        if c.kind == tl.COMP_RECV:
+            payload = (
+                ctypes.string_at(c.payload, c.payload_len) if c.payload else b""
+            )
+            with self._lock:
+                ch = self._channels.get(c.channel)
+            if ch is not None and self._recv_listener is not None:
+                self._recv_listener(ch, payload)
+            return
+        if c.kind == tl.COMP_SEND_DONE:
+            err = (
+                None
+                if c.status == tl.ST_OK
+                else ChannelError("send failed (channel down)")
+            )
+            self._complete_wr(c.wr_id, None, err)
+            return
+        if c.kind == tl.COMP_READ_DONE:
+            if c.status == tl.ST_OK:
+                self._complete_wr(c.wr_id, None, None)
+            elif c.status == tl.ST_REMOTE_ERR:
+                msg = (
+                    ctypes.string_at(c.payload, c.payload_len).decode("utf-8")
+                    if c.payload
+                    else "remote error"
+                )
+                self._complete_wr(c.wr_id, None, ChannelError(f"remote READ failed: {msg}"))
+            else:
+                self._complete_wr(c.wr_id, None, ChannelError("READ failed (channel down)"))
+            return
+        if c.kind == tl.COMP_CHANNEL_DOWN:
+            lost_peer: Optional[str] = None
+            with self._lock:
+                ch = self._channels.pop(c.channel, None)
+                peer = self._peer_of_channel.pop(c.channel, None)
+                if peer is not None and self._passive.get(peer) is ch:
+                    del self._passive[peer]
+                    lost_peer = peer
+                for key, a in list(self._active.items()):
+                    if a is ch:
+                        del self._active[key]
+            if ch is not None:
+                ch._dead.set()
+            if (
+                lost_peer is not None
+                and not self._stopped.is_set()
+                and self._peer_lost_listener is not None
+            ):
+                self._peer_lost_listener(lost_peer)
+            return
+
+    # ------------------------------------------------------------------
+    # channel cache (TpuNode.get_channel parity)
+    # ------------------------------------------------------------------
+    def get_channel(self, host: str, port: int, must_retry: bool = True) -> NativeTpuChannel:
+        key = (host, port)
+        with self._lock:
+            ch = self._active.get(key)
+            if ch is not None and ch.is_connected:
+                return ch
+            connect_lock = self._connect_locks.setdefault(key, threading.Lock())
+        with connect_lock:
+            with self._lock:
+                ch = self._active.get(key)
+                if ch is not None and ch.is_connected:
+                    return ch
+            attempts = self.conf.max_connection_attempts if must_retry else 1
+            cid = 0
+            for attempt in range(attempts):
+                cid = self._lib.srt_connect(
+                    self._np, host.encode(), port, self.port,
+                    self.executor_id.encode(), self.conf.connect_timeout_ms,
+                )
+                if cid:
+                    break
+                time.sleep(min(0.05 * (2 ** attempt), 1.0))
+            if not cid:
+                raise ChannelError(
+                    f"could not connect to {host}:{port} after {attempts} attempts"
+                )
+            ch = NativeTpuChannel(self, cid, f"{host}:{port}")
+            with self._lock:
+                self._channels[cid] = ch
+                self._active[key] = ch
+            return ch
+
+    def _close_channel(self, ch: NativeTpuChannel) -> None:
+        ch._dead.set()
+        if not self._stopped.is_set():
+            self._lib.srt_close_channel(self._np, ch.channel_id)
+
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._cq_thread.join(timeout=2.0)
+        # fail anything still outstanding (latch semantics)
+        with self._lock:
+            wrs = list(self._wrs.items())
+            self._wrs.clear()
+            channels = list(self._channels.values())
+            self._channels.clear()
+        for ch in channels:
+            ch._dead.set()
+        err = ChannelError("node stopped")
+        for _, (listener, _keep) in wrs:
+            if listener is not None:
+                try:
+                    listener.on_failure(err)
+                except Exception:
+                    logger.exception("listener on_failure raised")
+        # teardown order matters: pooled buffers deregister their regions
+        # through the native node, so it must still be alive here
+        self.buffer_manager.stop()
+        self.pd.dealloc()
+        np_handle, self._np = self._np, None
+        if np_handle:
+            self._lib.srt_node_stop(np_handle)

@@ -216,6 +216,16 @@ class TpuShuffleConf:
         """Timeout for driver location fetches (fetcher iterator wrapper)."""
         return self._int("partitionLocationFetchTimeoutMs", 30000, 100, 1 << 30)
 
+    # -- transport selection ----------------------------------------------
+    @property
+    def transport(self) -> str:
+        """Host transport data plane: ``python`` or ``native`` (C++ epoll
+        loop, sparkrdma_tpu/native/transport.cpp). Both speak the same
+        wire format and interoperate; native falls back to python when
+        the toolchain is unavailable."""
+        raw = (self._conf.get(PREFIX + "transport", "python") or "python").lower()
+        return raw if raw in ("python", "native") else "python"
+
     # -- TPU device exchange plane (new; no reference analogue) -----------
     @property
     def exchange_bucket_min(self) -> int:

@@ -1,0 +1,192 @@
+"""Native (C++) transport: verb-level tests, full shuffle e2e, and
+python<->native wire interop.
+
+The native plane (sparkrdma_tpu/native/transport.cpp) is the libdisni
+equivalent — frame parsing, passive READ service, and payload streaming
+run in an epoll loop outside Python (SURVEY.md §2.2)."""
+
+import threading
+
+import pytest
+
+from sparkrdma_tpu.native.transport_lib import available
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.transport import FnListener
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+pytestmark = pytest.mark.skipif(not available(), reason="native transport unavailable")
+
+
+def _native_conf(extra=None):
+    return TpuShuffleConf(
+        {
+            "tpu.shuffle.transport": "native",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleReadBlockSize": "65536",
+            **(extra or {}),
+        }
+    )
+
+
+def test_send_read_roundtrip():
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    got = []
+    ev = threading.Event()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "a")
+    b = NativeTpuNode(
+        conf, "127.0.0.1", True, "b",
+        recv_listener=lambda ch, p: (got.append(p), ev.set()),
+    )
+    try:
+        ch = a.get_channel("127.0.0.1", b.port)
+        done = threading.Event()
+        ch.send_in_queue(FnListener(lambda _: done.set()), [b"x" * 10000])
+        assert done.wait(5) and ev.wait(5)
+        assert got == [b"x" * 10000]
+
+        src = memoryview(bytearray(range(256)) * 64)
+        mkey = a.pd.register(src)
+        ch_ba = b.get_channel("127.0.0.1", a.port)
+        dst = memoryview(bytearray(4096))
+        rdone = threading.Event()
+        errs = []
+        ch_ba.read_in_queue(
+            FnListener(lambda _: rdone.set(), errs.append),
+            [dst],
+            [(mkey, 1024, 4096)],
+        )
+        assert rdone.wait(5), errs
+        assert bytes(dst) == bytes(src[1024:5120])
+
+        # bounds violation -> remote READ error, not silent corruption
+        bad = threading.Event()
+        failures = []
+        ch_ba.read_in_queue(
+            FnListener(None, lambda e: (failures.append(e), bad.set())),
+            [memoryview(bytearray(8))],
+            [(mkey, len(src) - 4, 8)],
+        )
+        assert bad.wait(5)
+        assert "READ failed" in str(failures[0])
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_shuffle_e2e_over_native_transport():
+    conf = _native_conf()
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")
+    try:
+        from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+        assert isinstance(driver.node, NativeTpuNode)
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=4, partitioner=HashPartitioner(5)
+        )
+        driver.register_shuffle(handle)
+        expected = {}
+        for map_id, ex in [(0, ex0), (1, ex0), (2, ex1), (3, ex1)]:
+            recs = [(f"key-{(map_id * 997 + i) % 131}", i) for i in range(2000)]
+            for k, v in recs:
+                expected.setdefault(k, []).append(v)
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(recs))
+            w.stop(True)
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+        got = {}
+        for ex, (lo, hi) in [(ex0, (0, 3)), (ex1, (3, 5))]:
+            reader = ex.get_reader(handle, lo, hi)
+            for k, v in reader.read():
+                got.setdefault(k, []).append(v)
+            assert reader.metrics.remote_blocks > 0
+        assert set(got) == set(expected)
+        for k in expected:
+            assert sorted(got[k]) == sorted(expected[k])
+    finally:
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
+def test_python_native_wire_interop():
+    """Same wire format: a pure-Python executor shuffles against a
+    native driver + native peer executor."""
+    native_conf = _native_conf()
+    driver = TpuShuffleManager(native_conf, is_driver=True)
+    # python-transport executor inherits the negotiated driver port
+    py_conf = TpuShuffleConf(
+        {**native_conf.to_dict(), "tpu.shuffle.transport": "python"}
+    )
+    ex_native = TpuShuffleManager(native_conf, is_driver=False, executor_id="exec-n")
+    ex_python = TpuShuffleManager(py_conf, is_driver=False, executor_id="exec-p")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=2, partitioner=HashPartitioner(2)
+        )
+        driver.register_shuffle(handle)
+        expected = {}
+        for map_id, ex in [(0, ex_native), (1, ex_python)]:
+            recs = [(f"k{(map_id * 31 + i) % 17}", i) for i in range(500)]
+            for k, v in recs:
+                expected.setdefault(k, []).append(v)
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(recs))
+            w.stop(True)
+        ex_native.finalize_maps(0)
+        ex_python.finalize_maps(0)
+        got = {}
+        for ex, (lo, hi) in [(ex_native, (0, 1)), (ex_python, (1, 2))]:
+            for k, v in ex.get_reader(handle, lo, hi).read():
+                got.setdefault(k, []).append(v)
+        assert set(got) == set(expected)
+        for k in expected:
+            assert sorted(got[k]) == sorted(expected[k])
+    finally:
+        ex_native.stop()
+        ex_python.stop()
+        driver.stop()
+
+
+def test_peer_loss_detected_natively():
+    conf = _native_conf()
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=1, partitioner=HashPartitioner(1)
+        )
+        driver.register_shuffle(handle)
+        w = ex0.get_writer(handle, 0)
+        w.write(iter([("a", 1)]))
+        w.stop(True)
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with driver._lock:
+                if driver._maps_done.get(0, 0) >= 1:
+                    break
+            time.sleep(0.02)
+        ex0.stop()
+        deadline = time.monotonic() + 5
+        pruned = False
+        while time.monotonic() < deadline:
+            with driver._lock:
+                locs = [
+                    loc
+                    for v in driver._partition_locations[0].values()
+                    for loc in v
+                ]
+            if not locs:
+                pruned = True
+                break
+            time.sleep(0.02)
+        assert pruned, "driver did not prune lost native peer"
+    finally:
+        driver.stop()
