@@ -9,6 +9,7 @@ sort kernels that make shuffle *compute* live where the bytes live.
 
 from sparkrdma_tpu.ops.exchange import ExchangeProgram, pack_blocks, unpack_blocks
 from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+from sparkrdma_tpu.ops.ring_attention import RingAttention
 
 __all__ = [
     "ExchangeProgram",
@@ -16,4 +17,5 @@ __all__ = [
     "unpack_blocks",
     "DeviceBuffer",
     "DeviceBufferManager",
+    "RingAttention",
 ]

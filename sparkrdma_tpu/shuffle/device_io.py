@@ -1,0 +1,169 @@
+"""Device shuffle IO — HBM staging on both ends of the shuffle.
+
+The north-star data path (SURVEY.md §7, BASELINE.json): map outputs
+stage from device HBM into *registered* host memory, locations publish
+to the driver hub, and reducers pull with one-sided READs landing
+blocks back into pooled HBM slabs for device compute — the tiered
+HBM -> host-registered -> HBM store of SURVEY.md §7.3(4).
+
+This is the raw-block sibling of the record-oriented writer/reader
+stack: same control plane (publish / fetch-locations / barrier), same
+registered-memory data plane, no serializer in the way. Each published
+partition block is one pooled registered buffer whose
+``(mkey, 0, length)`` triple is the advertised location.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
+from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
+from sparkrdma_tpu.transport import FnListener
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceShuffleIO:
+    """Per-executor device-block shuffle endpoint."""
+
+    def __init__(self, manager, device=None):
+        self._manager = manager
+        manager.start_node_if_missing()
+        conf = manager.conf
+        self._dev = DeviceBufferManager(
+            device=device,
+            max_bytes=conf.hbm_max_bytes,
+            prealloc=conf.max_agg_prealloc,
+            prealloc_size=conf.max_agg_block,
+        )
+        # published host-side registered buffers per shuffle (kept alive
+        # until unpublish — the serving side of one-sided READs)
+        self._published: Dict[int, List] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def device_buffers(self) -> DeviceBufferManager:
+        return self._dev
+
+    # ------------------------------------------------------------------
+    # map side: device -> registered host memory -> locations
+    # ------------------------------------------------------------------
+    def publish_device_blocks(
+        self,
+        shuffle_id: int,
+        partitions: Dict[int, "object"],
+        num_map_outputs: int = 1,
+    ) -> None:
+        """Stage per-partition device arrays into registered buffers and
+        publish their locations (one publish = one map output for the
+        driver's completeness barrier)."""
+        mgr = self._manager
+        locs: List[PartitionLocation] = []
+        staged = []
+        for pid, arr in partitions.items():
+            data = np.asarray(arr).tobytes()  # HBM -> host
+            buf = mgr.buffer_manager.get(len(data))
+            buf.write(data)
+            staged.append(buf)
+            locs.append(
+                PartitionLocation(
+                    mgr.local_manager_id,
+                    pid,
+                    BlockLocation(0, len(data), buf.mkey),
+                )
+            )
+        with self._lock:
+            self._published.setdefault(shuffle_id, []).extend(staged)
+        mgr.publish_partition_locations(
+            shuffle_id, -1, locs, num_map_outputs=num_map_outputs
+        )
+
+    # ------------------------------------------------------------------
+    # reduce side: one-sided READ -> HBM slab
+    # ------------------------------------------------------------------
+    def fetch_device_blocks(
+        self,
+        shuffle_id: int,
+        start_partition: int,
+        end_partition: int,
+        dtype=np.uint8,
+        timeout_s: Optional[float] = None,
+    ) -> Dict[int, List[DeviceBuffer]]:
+        """Pull every block of ``[start, end)`` into HBM slabs.
+
+        Local blocks short-circuit from the publisher's own registered
+        buffer (never looping through the network, SURVEY.md §5.1 #2).
+        Returns pid -> list of DeviceBuffers (caller frees)."""
+        mgr = self._manager
+        conf = mgr.conf
+        if timeout_s is None:
+            timeout_s = conf.fetch_location_timeout_ms / 1000.0
+        future = mgr.fetch_remote_partition_locations(
+            shuffle_id, start_partition, end_partition
+        )
+        try:
+            locations: List[PartitionLocation] = future.result(timeout=timeout_s)
+        except Exception as e:
+            raise MetadataFetchFailedError(shuffle_id, start_partition, str(e))
+
+        out: Dict[int, List[DeviceBuffer]] = {}
+        my_id = mgr.executor_id
+        pending: List[Tuple[PartitionLocation, object, threading.Event, list]] = []
+        for loc in locations:
+            if loc.manager_id.executor_id == my_id:
+                # local short-circuit straight from the registered region
+                view = mgr.node.pd.resolve(
+                    loc.block.mkey, loc.block.address, loc.block.length
+                )
+                dev = self._dev.stage_bytes(bytes(view))
+                out.setdefault(loc.partition_id, []).append(dev)
+                continue
+            reg = mgr.buffer_manager.get(loc.block.length)
+            done = threading.Event()
+            errbox: list = []
+            ch = mgr.get_channel_to(loc.manager_id)
+            ch.read_in_queue(
+                FnListener(
+                    lambda _, d=done: d.set(),
+                    lambda e, d=done, b=errbox: (b.append(e), d.set()),
+                ),
+                [reg.view[: loc.block.length]],
+                [(loc.block.mkey, loc.block.address, loc.block.length)],
+            )
+            pending.append((loc, reg, done, errbox))
+
+        for loc, reg, done, errbox in pending:
+            ok = done.wait(timeout_s)
+            if not ok or errbox:
+                reg.free()
+                err = errbox[0] if errbox else TimeoutError("fetch timed out")
+                raise FetchFailedError(
+                    loc.manager_id, shuffle_id, -1, loc.partition_id, str(err)
+                )
+            dev = self._dev.stage_bytes(
+                bytes(reg.view[: loc.block.length])
+            )
+            reg.free()
+            out.setdefault(loc.partition_id, []).append(dev)
+        return out
+
+    # ------------------------------------------------------------------
+    def unpublish(self, shuffle_id: int) -> None:
+        """Release the registered buffers serving a shuffle's blocks."""
+        with self._lock:
+            staged = self._published.pop(shuffle_id, [])
+        for buf in staged:
+            self._manager.buffer_manager.put(buf)
+
+    def stop(self) -> None:
+        with self._lock:
+            shuffles = list(self._published.keys())
+        for sid in shuffles:
+            self.unpublish(sid)
+        self._dev.stop()

@@ -1,0 +1,43 @@
+"""Device PageRank (multi-round all-to-all) vs numpy power iteration."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.models.pagerank import PageRank, reference_pagerank
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+
+def _random_graph(n, m, seed=0):
+    rng = np.random.default_rng(seed)
+    edges = rng.integers(0, n, size=(m, 2), dtype=np.int64)
+    return edges
+
+
+def test_pagerank_matches_reference():
+    n, m = 200, 1500
+    edges = _random_graph(n, m)
+    pr = PageRank(make_mesh())
+    out = pr.run(edges, n, iters=15)
+    ref = reference_pagerank(edges, n, iters=15)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+    # ranks are a probability distribution
+    assert abs(out.sum() - 1.0) < 1e-3
+
+
+def test_pagerank_with_dangling_nodes():
+    # a path graph 0 -> 1 -> 2; node 2 dangles (no out-edges)
+    edges = np.array([[0, 1], [1, 2]])
+    pr = PageRank(make_mesh())
+    out = pr.run(edges, 3, iters=30)
+    ref = reference_pagerank(edges, 3, iters=30)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+    assert out[2] > out[1] > out[0]  # rank accumulates down the path
+
+
+def test_pagerank_on_2d_mesh():
+    n, m = 128, 800
+    edges = _random_graph(n, m, seed=3)
+    pr = PageRank(make_mesh(num_slices=2))
+    out = pr.run(edges, n, iters=10)
+    ref = reference_pagerank(edges, n, iters=10)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
