@@ -105,6 +105,10 @@ class ExchangeProgram:
         self.num_shards = math.prod(mesh.shape[a] for a in self.axes)
         self._all_to_all_cache = {}
         self._ring_cache = {}
+        # transfer accounting (reference: pool/read stats at stop,
+        # RdmaBufferManager.java:131-141, RdmaShuffleReaderStats)
+        self.exchanges = 0
+        self.bytes_moved = 0
 
     # -- schedule 1: XLA-native dense all-to-all ---------------------------
     def _build_all_to_all(self, rows: int, block: int, dtype) -> "jax.stages.Wrapped":
@@ -148,6 +152,8 @@ class ExchangeProgram:
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         send = jax.device_put(send, sharding)
         counts = jax.device_put(counts, sharding)
+        self.exchanges += 1
+        self.bytes_moved += send.size * jnp.dtype(send.dtype).itemsize
         return fn(send, counts)
 
     # -- schedule 2: staged ring (ppermute) --------------------------------
@@ -215,4 +221,6 @@ class ExchangeProgram:
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         send = jax.device_put(send, sharding)
         counts = jax.device_put(counts, sharding)
+        self.exchanges += 1
+        self.bytes_moved += send.size * jnp.dtype(send.dtype).itemsize
         return fn(send, counts)
