@@ -1,0 +1,152 @@
+"""Pallas flash attention — the on-chip kernel for the attention hot op.
+
+Single-device exact attention with O(block) memory, written as a TPU
+Pallas kernel (guide: /opt/skills/guides/pallas_guide.md). The grid is
+(batch, heads, q-blocks, k-blocks) with the k axis minor, so the
+running online-softmax statistics (max, denominator, accumulator) live
+in VMEM scratch across the k sweep — init at the first k block,
+finalize into the output at the last. This is the same blockwise
+recurrence :mod:`sparkrdma_tpu.ops.ring_attention` runs *across
+devices*; here it runs across VMEM tiles within one chip, keeping the
+[Sq, Sk] score matrix out of HBM entirely.
+
+Falls back to interpreter mode off-TPU (used by the CPU test mesh), so
+the same code path is exercised everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, scale, causal, block_q, block_k, num_kv_blocks, seq_len,
+            precision):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+    k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0, 0].astype(jnp.float32)  # [bk, d]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ) * scale  # [bq, bk]
+
+    # mask padded kv rows (seq padded up to a block multiple) and, if
+    # causal, future positions — all from static block indices
+    kv_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = kv_pos < seq_len
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        mask = mask & (q_pos >= kv_pos)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[...][:, 0]          # [bq] (value slice, lanes equal)
+    l_prev = l_ref[...][:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])    # [bq, bk]
+    l_new = l_prev * corr + p.sum(axis=-1)
+    acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finalize():
+        l = l_ref[...][:, 0]
+        # fully-masked rows (query padding) have l == 0; emit zeros
+        denom = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q, k, v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    precision=None,
+):
+    """Exact attention over [B, S, H, D] inputs via a Pallas TPU kernel.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU.
+    ``precision=None`` uses HIGHEST for fp32 inputs (the MXU otherwise
+    decomposes fp32 matmuls into bf16 passes, ~1e-2 score error) and
+    the default for bf16 inputs."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if precision is None:
+        precision = (
+            jax.lax.Precision.HIGHEST
+            if q.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT
+        )
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, max(8, s))
+    block_k = min(block_k, max(8, s))
+    s_pad = int(math.ceil(s / max(block_q, block_k))) * max(block_q, block_k)
+
+    def prep(x):
+        x = jnp.transpose(x, (0, 2, 1, 3))  # [B, H, S, D]
+        if s_pad != s:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+        return x
+
+    qt, kt, vt = prep(q), prep(k), prep(v)
+    nq = s_pad // block_q
+    nk = s_pad // block_k
+
+    kernel = functools.partial(
+        _kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_kv_blocks=nk,
+        seq_len=s,
+        precision=precision,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denominator
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = out[:, :, :s, :]
+    return jnp.transpose(out, (0, 2, 1, 3))  # back to [B, S, H, D]
