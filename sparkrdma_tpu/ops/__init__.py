@@ -9,9 +9,11 @@ sort kernels that make shuffle *compute* live where the bytes live.
 
 from sparkrdma_tpu.ops.exchange import ExchangeProgram, pack_blocks, unpack_blocks
 from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+from sparkrdma_tpu.ops.pallas_attention import flash_attention
 from sparkrdma_tpu.ops.ring_attention import RingAttention
 
 __all__ = [
+    "flash_attention",
     "ExchangeProgram",
     "pack_blocks",
     "unpack_blocks",

@@ -77,6 +77,7 @@ class TpuChannel:
         peer_desc: str,
         on_recv=None,
         on_disconnect=None,
+        cpu_vector: Optional[int] = None,
     ):
         self.conf = conf
         self.pd = pd
@@ -95,6 +96,7 @@ class TpuChannel:
         self._warned_oversubscription = False
         self._error: Optional[Exception] = None
         self._stopped = False
+        self._cpu_vector = cpu_vector
 
         self._recv_thread = threading.Thread(
             target=self._process_completions, name=f"cq-{peer_desc}", daemon=True
@@ -214,6 +216,10 @@ class TpuChannel:
     # completion processing (reference exhaustCq/processCompletions)
     # ------------------------------------------------------------------
     def _process_completions(self) -> None:
+        # per-channel CQ thread pins to its CPU vector (RdmaThread.java:44-46)
+        from sparkrdma_tpu.utils.affinity import pin_current_thread
+
+        pin_current_thread(self._cpu_vector)
         try:
             while True:
                 op_raw = self._sock.recv(1)

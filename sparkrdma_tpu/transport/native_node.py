@@ -305,6 +305,11 @@ class NativeTpuNode:
     # CQ poll loop (RdmaThread analogue)
     # ------------------------------------------------------------------
     def _poll_loop(self) -> None:
+        # the node-wide CQ thread takes the first configured vector
+        # (RdmaThread pinning analogue)
+        from sparkrdma_tpu.utils.affinity import CpuVectorAllocator, pin_current_thread
+
+        pin_current_thread(CpuVectorAllocator(self.conf.cpu_list).next_vector())
         comps = (tl.SrtComp * 64)()
         while not self._stopped.is_set():
             k = self._lib.srt_poll_cq(self._np, comps, 64, 100)

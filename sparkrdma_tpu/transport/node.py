@@ -65,6 +65,9 @@ class TpuNode:
             max_agg_prealloc=conf.max_agg_prealloc,
         )
 
+        from sparkrdma_tpu.utils.affinity import CpuVectorAllocator
+
+        self._cpu_vectors = CpuVectorAllocator(conf.cpu_list)
         self._active: Dict[Tuple[str, int], TpuChannel] = {}
         self._passive: Dict[str, TpuChannel] = {}  # keyed by peer executor_id
         self._lock = threading.Lock()
@@ -124,6 +127,7 @@ class TpuNode:
                 peer_desc=f"{peer_id}@{addr[0]}:{peer_port}",
                 on_recv=self._recv_listener,
                 on_disconnect=self._on_passive_disconnect,
+                cpu_vector=self._cpu_vectors.next_vector(),
             )
             with self._lock:
                 if self._stopped:
@@ -210,6 +214,7 @@ class TpuNode:
             sock,
             peer_desc=f"{host}:{port}",
             on_recv=self._recv_listener,
+            cpu_vector=self._cpu_vectors.next_vector(),
         )
         logger.debug(
             "connected to %s:%d in %.1f ms", host, port, (time.monotonic() - start) * 1e3

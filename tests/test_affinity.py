@@ -1,0 +1,35 @@
+"""cpuList parsing + round-robin vector allocation (RdmaNode.java:221-277)."""
+
+from sparkrdma_tpu.utils.affinity import (
+    CpuVectorAllocator,
+    parse_cpu_list,
+    pin_current_thread,
+)
+
+
+def test_parse_ranges_and_singles():
+    import os
+
+    avail = os.sched_getaffinity(0)
+    cpus = parse_cpu_list("0-2,5, 7 ,bogus,")
+    assert all(c in avail for c in cpus)
+    assert all(c in (0, 1, 2, 5, 7) for c in cpus)
+
+
+def test_empty_list_means_no_pinning():
+    alloc = CpuVectorAllocator("")
+    assert alloc.next_vector() is None
+    assert not pin_current_thread(None)
+
+
+def test_round_robin_cycles():
+    alloc = CpuVectorAllocator("0", seed=1)
+    got = [alloc.next_vector() for _ in range(3)]
+    assert got == [0, 0, 0]  # single-cpu box: same vector reused
+
+
+def test_pin_current_thread_on_valid_cpu():
+    import os
+
+    cpu = sorted(os.sched_getaffinity(0))[0]
+    assert pin_current_thread(cpu)
