@@ -6,7 +6,8 @@ that missing benchmark code for the TPU framework: fully-jittable
 distributed workloads built on the device exchange plane.
 """
 
+from sparkrdma_tpu.models.als import ALS
 from sparkrdma_tpu.models.pagerank import PageRank
 from sparkrdma_tpu.models.terasort import TeraSorter
 
-__all__ = ["PageRank", "TeraSorter"]
+__all__ = ["ALS", "PageRank", "TeraSorter"]
