@@ -7,7 +7,8 @@ distributed workloads built on the device exchange plane.
 """
 
 from sparkrdma_tpu.models.als import ALS
+from sparkrdma_tpu.models.hashjoin import HashJoin
 from sparkrdma_tpu.models.pagerank import PageRank
 from sparkrdma_tpu.models.terasort import TeraSorter
 
-__all__ = ["ALS", "PageRank", "TeraSorter"]
+__all__ = ["ALS", "HashJoin", "PageRank", "TeraSorter"]

@@ -1,0 +1,106 @@
+"""Fault injection — the failure-path harness the reference lacks.
+
+SURVEY.md §5 records that the reference has no fault injection; §4 says
+the new framework must design the strategy the reference lacks. These
+tests inject transport faults at the verb layer (the
+`RdmaCompletionListener.onFailure` seam) and assert the degradation
+chain: failed READ -> FetchFailedError -> engine recomputes the stage
+-> correct results (SURVEY.md §5.1 #9: failures degrade to retry
+machinery, never hang the iterator)."""
+
+import threading
+
+import pytest
+
+from sparkrdma_tpu.engine.context import TpuContext
+from sparkrdma_tpu.transport.channel import ChannelError, TpuChannel
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+@pytest.fixture
+def flaky_reads(monkeypatch):
+    """Fail the first N one-sided READs at post time, then heal."""
+    state = {"remaining": 0, "injected": 0}
+    lock = threading.Lock()
+    original = TpuChannel.read_in_queue
+
+    def wrapper(self, listener, dst_views, blocks):
+        with lock:
+            inject = state["remaining"] > 0
+            if inject:
+                state["remaining"] -= 1
+                state["injected"] += 1
+        if inject:
+            listener.on_failure(ChannelError("injected read fault"))
+            return
+        return original(self, listener, dst_views, blocks)
+
+    monkeypatch.setattr(TpuChannel, "read_in_queue", wrapper)
+    return state
+
+
+def test_injected_read_fault_triggers_recompute(flaky_reads):
+    flaky_reads["remaining"] = 2
+    with TpuContext(num_executors=2, task_threads=2) as ctx:
+        rdd = (
+            ctx.parallelize(range(2000), 4)
+            .map(lambda x: (x % 13, x))
+            .reduce_by_key(lambda a, b: a + b, num_partitions=4)
+        )
+        out = dict(ctx.run_job(rdd))
+    assert flaky_reads["injected"] == 2  # the faults actually fired
+    expected = {}
+    for x in range(2000):
+        expected[x % 13] = expected.get(x % 13, 0) + x
+    assert out == expected
+
+
+def test_reduce_task_surfaces_failure_not_hang(flaky_reads):
+    """With faults outlasting every retry, the job fails promptly with a
+    ShuffleError instead of hanging the iterator (invariant #9)."""
+    from sparkrdma_tpu.shuffle.errors import ShuffleError
+
+    flaky_reads["remaining"] = 10**9
+    with TpuContext(num_executors=2, task_threads=2) as ctx:
+        rdd = (
+            ctx.parallelize(range(500), 4)
+            .map(lambda x: (x % 7, x))
+            .group_by_key(num_partitions=4)
+        )
+        with pytest.raises(ShuffleError):
+            ctx.run_job(rdd)
+
+
+def test_send_fault_fails_location_fetch(monkeypatch):
+    """An injected SEND fault on the location-fetch RPC surfaces as
+    MetadataFetchFailedError (timeout path), not a hang."""
+    from sparkrdma_tpu.shuffle.errors import MetadataFetchFailedError
+    from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf({"tpu.shuffle.partitionLocationFetchTimeoutMs": "400"})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=1, partitioner=HashPartitioner(1)
+        )
+        driver.register_shuffle(handle)
+        w = ex0.get_writer(handle, 0)
+        w.write(iter([("a", 1)]))
+        w.stop(True)
+
+        original = TpuChannel.send_in_queue
+
+        def drop_fetches(self, listener, segments):
+            # swallow the message entirely: the reply never comes
+            listener.on_success(None)
+
+        monkeypatch.setattr(TpuChannel, "send_in_queue", drop_fetches)
+        reader = ex0.get_reader(handle, 0, 1)
+        with pytest.raises(MetadataFetchFailedError):
+            list(reader.read())
+        monkeypatch.setattr(TpuChannel, "send_in_queue", original)
+    finally:
+        ex0.stop()
+        driver.stop()
