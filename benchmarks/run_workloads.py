@@ -1,0 +1,162 @@
+"""Workload benchmark suite — the HiBench role (SURVEY.md §6).
+
+Runs the BASELINE.md workload set against this framework and prints one
+JSON line per workload:
+
+  1. TeraSort via the HOST engine (full shuffle path: writers,
+     registered memory, one-sided READs, fetcher) — BASELINE config #1
+     shape, scaled by --scale.
+  2. TeraSort via the DEVICE plane (partition -> all_to_all -> merge).
+  3. PageRank (multi-round all-to-all).
+  4. ALS (iterative wide shuffle).
+  5. Hash join (shuffle-heavy join).
+
+Usage: python benchmarks/run_workloads.py [--scale 0.05] [--transport native]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def report(workload, seconds, **extra):
+    print(
+        json.dumps(
+            {"workload": workload, "seconds": round(seconds, 4), **extra}
+        ),
+        flush=True,
+    )
+
+
+def bench_engine_terasort(scale: float, transport: str):
+    from sparkrdma_tpu.engine.context import TpuContext
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    n = int(1_000_000 * scale)  # records of ~100B => scale * 100MB
+    conf = TpuShuffleConf({"tpu.shuffle.transport": transport})
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 32, n, dtype=np.uint64)
+
+    with TpuContext(num_executors=2, conf=conf, task_threads=4) as ctx:
+        data = [(int(k), b"x" * 90) for k in keys]
+        t0 = time.perf_counter()
+        rdd = ctx.parallelize(data, 8).sort_by_key(num_partitions=8)
+        out = ctx.run_job(rdd)
+        dt = time.perf_counter() - t0
+    assert len(out) == n
+    assert all(out[i][0] <= out[i + 1][0] for i in range(min(1000, n - 1)))
+    report(
+        "terasort_engine", dt,
+        records=n, transport=transport,
+        mb=round(n * 100 / 1e6, 1),
+        records_per_s=int(n / dt),
+    )
+
+
+def bench_device_terasort(scale: float):
+    import jax
+
+    from sparkrdma_tpu.models import TeraSorter
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    n = int((1 << 24) * scale * 20)  # default scale 0.05 -> 16M keys
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    sorter = TeraSorter(make_mesh())
+    sorter.sort(keys)  # warm: compile at the real shape
+    t0 = time.perf_counter()
+    out = sorter.sort(keys)
+    dt = time.perf_counter() - t0
+    assert len(out) == n
+    report(
+        "terasort_device", dt,
+        keys=n, devices=len(jax.devices()),
+        gbps=round(n * 4 / dt / 1e9, 3),
+    )
+
+
+def bench_pagerank(scale: float):
+    from sparkrdma_tpu.models import PageRank
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    n = int(20000 * scale * 20)
+    m = n * 8
+    rng = np.random.default_rng(0)
+    edges = rng.integers(0, n, size=(m, 2), dtype=np.int64)
+    pr = PageRank(make_mesh())
+    pr.run(edges, n, iters=10)  # warm compile
+    t0 = time.perf_counter()
+    ranks = pr.run(edges, n, iters=10)
+    dt = time.perf_counter() - t0
+    assert abs(ranks.sum() - 1.0) < 1e-2
+    report("pagerank", dt, vertices=n, edges=m, iters=10)
+
+
+def bench_als(scale: float):
+    from sparkrdma_tpu.models import ALS
+    from sparkrdma_tpu.models.als import rmse
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    n_u = int(2000 * scale * 20)
+    n_i = n_u // 2
+    m = n_u * 10
+    rng = np.random.default_rng(0)
+    tu = rng.normal(size=(n_u, 4))
+    tv = rng.normal(size=(n_i, 4))
+    users = rng.integers(0, n_u, m)
+    items = rng.integers(0, n_i, m)
+    vals = (tu[users] * tv[items]).sum(1)
+    ratings = np.stack([users, items, vals], 1)
+    als = ALS(make_mesh(), rank=8)
+    als.fit(ratings, n_u, n_i, iters=5)  # warm compile
+    t0 = time.perf_counter()
+    u, v = als.fit(ratings, n_u, n_i, iters=5)
+    dt = time.perf_counter() - t0
+    report("als", dt, users=n_u, items=n_i, ratings=m, rmse=round(rmse(u, v, ratings), 4))
+
+
+def bench_hashjoin(scale: float):
+    from sparkrdma_tpu.models import HashJoin
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    nb = int(10000 * scale * 20)
+    npr = nb * 8
+    rng = np.random.default_rng(0)
+    bk = rng.choice(1 << 24, nb, replace=False).astype(np.uint32)
+    bv = rng.integers(0, 1 << 20, nb).astype(np.int32)
+    pk = rng.choice(bk, npr).astype(np.uint32)
+    pv = np.arange(npr, dtype=np.int32)
+    hj = HashJoin(make_mesh())
+    hj.join(bk, bv, pk, pv)  # warm compile
+    t0 = time.perf_counter()
+    out = hj.join(bk, bv, pk, pv)
+    dt = time.perf_counter() - t0
+    assert len(out) == npr
+    report("hashjoin", dt, build=nb, probe=npr, rows_per_s=int(npr / dt))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.05)
+    ap.add_argument("--transport", default="python", choices=["python", "native"])
+    ap.add_argument(
+        "--only", default=None,
+        choices=[None, "engine", "terasort", "pagerank", "als", "join"],
+    )
+    args = ap.parse_args()
+    runs = {
+        "engine": lambda: bench_engine_terasort(args.scale, args.transport),
+        "terasort": lambda: bench_device_terasort(args.scale),
+        "pagerank": lambda: bench_pagerank(args.scale),
+        "als": lambda: bench_als(args.scale),
+        "join": lambda: bench_hashjoin(args.scale),
+    }
+    for name, fn in runs.items():
+        if args.only in (None, name):
+            fn()

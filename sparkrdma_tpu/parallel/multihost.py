@@ -1,0 +1,64 @@
+"""Multi-host bootstrap — scaling the mesh across processes and slices.
+
+The reference scales out with one RdmaNode per JVM and a full mesh of
+RC queue pairs over RoCE (SURVEY.md §2.4). The TPU-native scale-out
+needs no per-peer connection state at all: each host process calls
+:func:`initialize` (a thin wrapper over ``jax.distributed``), after
+which ``jax.devices()`` spans every host and :func:`global_mesh` builds
+the framework's ``(dcn, exec)`` mesh over all of them — intra-slice
+collectives ride ICI, cross-slice DCN, with XLA owning the transport
+(the NCCL/MPI-equivalent role of the reference's verbs layer).
+
+The host control plane (driver hub, location RPC) is transport-
+independent and keeps working unchanged across hosts — executors just
+pass real hostnames instead of 127.0.0.1.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import jax
+
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host JAX runtime (no-op for single-process runs).
+
+    On Cloud TPU all three arguments are auto-detected from the
+    metadata environment; pass them explicitly elsewhere
+    (``host0:port``, world size, this process's rank)."""
+    if num_processes is not None and num_processes <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # already initialized: idempotent like startRdmaNodeIfMissing
+        if "already" not in str(e).lower():
+            raise
+        logger.debug("jax.distributed already initialized: %s", e)
+
+
+def global_mesh(num_slices: Optional[int] = None):
+    """The framework mesh over every device of every host."""
+    return make_mesh(jax.devices(), num_slices=num_slices)
+
+
+def local_device_indices() -> Sequence[int]:
+    """Global shard indices owned by this process (for feeding
+    per-host input pipelines into a globally-sharded array)."""
+    all_devices = list(jax.devices())
+    local = set(d.id for d in jax.local_devices())
+    return [i for i, d in enumerate(all_devices) if d.id in local]
