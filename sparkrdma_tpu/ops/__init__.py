@@ -11,6 +11,7 @@ from sparkrdma_tpu.ops.exchange import ExchangeProgram, pack_blocks, unpack_bloc
 from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
 from sparkrdma_tpu.ops.pallas_attention import flash_attention
 from sparkrdma_tpu.ops.ring_attention import RingAttention
+from sparkrdma_tpu.ops.ulysses_attention import UlyssesAttention
 
 __all__ = [
     "flash_attention",
@@ -20,4 +21,5 @@ __all__ = [
     "DeviceBuffer",
     "DeviceBufferManager",
     "RingAttention",
+    "UlyssesAttention",
 ]

@@ -1,0 +1,108 @@
+"""Ulysses-style sequence parallelism — the all-to-all SP schedule.
+
+The second of the two sequence-parallel schedules (the first,
+:mod:`ring_attention`, streams kv blocks around the ring). Ulysses
+re-shards with two all-to-alls instead: heads are scattered and
+sequence gathered, so each device computes FULL-sequence attention for
+its subset of heads, then the output is re-sharded back to sequence.
+One dense exchange each way — the same ``lax.all_to_all`` the shuffle
+read path rides — versus the ring's E-1 neighbour hops; Ulysses wins
+when head count ≥ shard count and the interconnect is all-to-all
+capable (ICI), the ring when sequence is extreme or only neighbour
+bandwidth is available.
+
+Requires ``num_heads % num_shards == 0``. The per-device full-sequence
+attention uses the Pallas flash kernel on TPU (interpreter off-TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.ops.pallas_attention import flash_attention
+from sparkrdma_tpu.parallel.mesh import make_mesh
+
+
+class UlyssesAttention:
+    """Compile-once all-to-all sequence-parallel attention."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: Optional[str] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if axis is None:
+            axis = self.mesh.axis_names[-1]
+        self.axis = axis
+        self.num_shards = self.mesh.shape[axis]
+        self._cache = {}
+
+    def _build(self, shape, dtype, causal: bool, use_flash: bool):
+        e = self.num_shards
+        axis = self.axis
+        spec = P(None, axis, None, None)  # sharded on sequence
+
+        def shard_fn(q, k, v):
+            # local [B, S/E, H, D] -> all_to_all over heads:
+            # split H into E groups, gather full sequence per group
+            def seq_to_heads(x):
+                # [B, s, H, D] -> [B, s, E, H/E, D] -> a2a on E
+                b, s, h, d = x.shape
+                x = x.reshape(b, s, e, h // e, d)
+                # move the exchange dim to front for tiled all_to_all
+                x = jnp.moveaxis(x, 2, 0)  # [E, B, s, H/E, d]
+                x = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+                # received: [E, B, s, H/E, d] where dim 0 is now seq blocks
+                x = jnp.moveaxis(x, 0, 2)  # [B, s, E, H/E, d] -> seq major
+                b_, s_, e_, hh, d_ = x.shape
+                return jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(b_, e_ * s_, hh, d_)
+
+            def heads_to_seq(x):
+                # [B, S, H/E, D] -> back to [B, S/E, H, D]
+                b, s_full, hh, d = x.shape
+                s = s_full // e
+                x = x.reshape(b, e, s, hh, d)
+                x = jnp.moveaxis(x, 1, 0)  # [E, B, s, hh, d]
+                x = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+                # dim 0 now indexes the head GROUP each peer owned —
+                # restore group-major head order
+                x = jnp.moveaxis(x, 0, 2)  # [B, s, E(group), hh, d]
+                b_, s_, e_, hh_, d_ = x.shape
+                return x.reshape(b_, s_, e_ * hh_, d_)
+
+            qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+            if use_flash:
+                out = flash_attention(qh, kh, vh, causal=causal)
+            else:
+                from sparkrdma_tpu.ops.ring_attention import reference_attention
+
+                out = reference_attention(qh, kh, vh, causal=causal)
+            return heads_to_seq(out)
+
+        fn = shard_map(
+            shard_fn,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def __call__(self, q, k, v, causal: bool = False, use_flash: bool = True):
+        b, s, h, d = q.shape
+        if h % self.num_shards:
+            raise ValueError(
+                f"num_heads {h} must divide by shard count {self.num_shards}"
+            )
+        key = (q.shape, jnp.dtype(q.dtype).name, causal, use_flash)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(q.shape, q.dtype, causal, use_flash)
+            self._cache[key] = fn
+        sharding = NamedSharding(self.mesh, P(None, self.axis, None, None))
+        q = jax.device_put(q, sharding)
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+        return fn(q, k, v)
