@@ -98,17 +98,76 @@ class NativeProtectionDomain:
 
 
 class NativeTpuChannel:
-    """Handle to one native connection (id-based)."""
+    """Handle to one native connection (id-based).
+
+    Carries the reference's **send-budget** semantics
+    (RdmaChannel.java:54-56, 330-358): ``send_queue_depth`` permits per
+    channel, one per WR (send segment or read block); WRs that cannot
+    acquire permits queue in an overflow deque drained as completions
+    reclaim, with a one-time oversubscription warning."""
 
     def __init__(self, node: "NativeTpuNode", channel_id: int, peer_desc: str):
         self._node = node
         self.channel_id = channel_id
         self.peer_desc = peer_desc
         self._dead = threading.Event()
+        self._budget = node.conf.send_queue_depth
+        self._budget_lock = threading.Lock()
+        self._overflow: "list" = []
+        self._warned_oversubscription = False
+
+    def _acquire_or_queue(self, permits: int, item) -> bool:
+        with self._budget_lock:
+            if self._budget >= permits:
+                self._budget -= permits
+                return True
+            if not self._warned_oversubscription:
+                self._warned_oversubscription = True
+                logger.warning(
+                    "channel %s send queue oversubscribed; consider raising "
+                    "tpu.shuffle.sendQueueDepth (current %d)",
+                    self.peer_desc, self._node.conf.send_queue_depth,
+                )
+            self._overflow.append(item)
+            return False
+
+    def _reclaim(self, permits: int) -> None:
+        runnable = []
+        with self._budget_lock:
+            self._budget += permits
+            while self._overflow:
+                p, fn = self._overflow[0]
+                if self._budget < p:
+                    break
+                self._budget -= p
+                runnable.append(fn)
+                self._overflow.pop(0)
+        for fn in runnable:
+            fn()
+
+    def _wrap_reclaim(self, listener: Optional[CompletionListener], permits: int):
+        from sparkrdma_tpu.transport.completion import FnListener
+
+        def ok(payload):
+            self._reclaim(permits)
+            if listener:
+                listener.on_success(payload)
+
+        def err(e):
+            self._reclaim(permits)
+            if listener:
+                listener.on_failure(e)
+
+        return FnListener(ok, err)
 
     # -- verb API (parity with TpuChannel) -----------------------------
     def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
-        self._node._post_send(self, listener, segments)
+        segments = [bytes(s) for s in segments]
+        permits = max(1, len(segments))
+        wrapped = self._wrap_reclaim(listener, permits)
+        post = lambda: self._node._post_send(self, wrapped, segments)
+        if self._acquire_or_queue(permits, (permits, post)):
+            post()
 
     def read_in_queue(
         self,
@@ -119,7 +178,11 @@ class NativeTpuChannel:
         total = sum(b[2] for b in blocks)
         if sum(len(v) for v in dst_views) != total:
             raise ValueError("destination size != total remote block length")
-        self._node._post_read(self, listener, dst_views, blocks)
+        permits = max(1, len(blocks))
+        wrapped = self._wrap_reclaim(listener, permits)
+        post = lambda: self._node._post_read(self, wrapped, dst_views, blocks)
+        if self._acquire_or_queue(permits, (permits, post)):
+            post()
 
     @property
     def is_connected(self) -> bool:

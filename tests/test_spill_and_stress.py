@@ -1,0 +1,152 @@
+"""Memory-budget spill path + native transport stress.
+
+Covers: chunked-agg blocks spilling to registered scratch files when
+the executor in-memory budget is exhausted (reference
+RdmaShufflePartitionWriter.scala:42-52) with remote reads still served
+from the file-backed regions; and the native data plane under
+concurrent multi-megabyte READs (exercising partial-write/EPOLLOUT and
+partial-read framing paths)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.native.transport_lib import available
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.transport import FnListener
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+def test_chunked_agg_spills_to_file_blocks_under_budget():
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "chunkedpartitionagg",
+            # budget admits ~1 block; the rest must spill to scratch files
+            "tpu.shuffle.shuffleWriteMaxInMemoryStoragePerExecutor": "65536",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleWriteFlushSize": "8192",
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=2, partitioner=HashPartitioner(2)
+        )
+        driver.register_shuffle(handle)
+        expected = {}
+        rng = np.random.default_rng(0)
+        for map_id, ex in [(0, ex0), (1, ex1)]:
+            # incompressible values so flushed frames stay large
+            recs = [
+                (int(k), rng.bytes(400))
+                for k in rng.integers(0, 50, 800)
+            ]
+            for k, v in recs:
+                expected.setdefault(k, []).append(v)
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(recs))
+            w.stop(True)
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+
+        # the budget must actually have forced file blocks
+        from sparkrdma_tpu.shuffle.writer.blocks import FileWriterBlock
+        from sparkrdma_tpu.shuffle.writer.chunked_agg import ChunkedAggShuffleData
+
+        spilled = 0
+        for ex in (ex0, ex1):
+            data = ex.resolver.get_shuffle_data(0)
+            assert isinstance(data, ChunkedAggShuffleData)
+            for pw in data._writers.values():
+                spilled += sum(
+                    1 for b in pw._blocks if isinstance(b, FileWriterBlock)
+                )
+        assert spilled > 0, "budget never forced a file-backed block"
+
+        got = {}
+        for ex, (lo, hi) in [(ex0, (0, 1)), (ex1, (1, 2))]:
+            for k, v in ex.get_reader(handle, lo, hi).read():
+                got.setdefault(k, []).append(v)
+        assert set(got) == set(expected)
+        for k in expected:
+            assert sorted(got[k]) == sorted(expected[k])
+    finally:
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
+@pytest.mark.skipif(not available(), reason="native transport unavailable")
+def test_native_concurrent_large_reads():
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "stress-a")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "stress-b")
+    try:
+        n = 4 * 1024 * 1024
+        src = np.random.default_rng(1).integers(0, 256, n, dtype=np.uint8)
+        region = memoryview(bytearray(src.tobytes()))
+        mkey = a.pd.register(region)
+        ch = b.get_channel("127.0.0.1", a.port)
+
+        results = []
+        events = []
+        for i in range(8):
+            off = i * (n // 8)
+            length = n // 8
+            dst = memoryview(bytearray(length))
+            ev = threading.Event()
+            errs = []
+            ch.read_in_queue(
+                FnListener(
+                    lambda _, e=ev: e.set(),
+                    lambda ex, e=ev, er=errs: (er.append(ex), e.set()),
+                ),
+                [dst],
+                [(mkey, off, length)],
+            )
+            results.append((off, length, dst, errs))
+            events.append(ev)
+        for ev in events:
+            assert ev.wait(20), "stress read timed out"
+        for off, length, dst, errs in results:
+            assert not errs, errs
+            assert bytes(dst) == src[off : off + length].tobytes()
+    finally:
+        b.stop()
+        a.stop()
+
+
+@pytest.mark.skipif(not available(), reason="native transport unavailable")
+def test_native_send_budget_overflow_drains():
+    """More posted WRs than permits: all must still complete in order
+    of eligibility, with the overflow deque draining on completions."""
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf({"tpu.shuffle.sendQueueDepth": "256"})
+    got = []
+    done = threading.Event()
+    total = 600  # > budget
+
+    def on_recv(ch, payload):
+        got.append(payload)
+        if len(got) == total:
+            done.set()
+
+    a = NativeTpuNode(conf, "127.0.0.1", False, "budget-a")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "budget-b", recv_listener=on_recv)
+    try:
+        ch = a.get_channel("127.0.0.1", b.port)
+        for i in range(total):
+            ch.send_in_queue(FnListener(), [b"m%06d" % i])
+        assert done.wait(20), f"only {len(got)}/{total} arrived"
+        assert sorted(got) == [b"m%06d" % i for i in range(total)]
+        assert ch._budget <= conf.send_queue_depth
+    finally:
+        a.stop()
+        b.stop()
