@@ -186,6 +186,7 @@ struct Node {
   std::mutex conn_mu;  // guards id->Conn* map (loop thread owns Conn bodies)
   std::unordered_map<uint64_t, Conn*> conns;
   uint64_t next_conn = 1;
+  std::vector<Conn*> graveyard;  // loop-thread-only: dead conns awaiting free
 
   void post(Completion c) {
     {
@@ -233,6 +234,19 @@ void fail_conn(Node* n, Conn* c) {
     n->post(comp);
   }
   c->reads.clear();
+  // ...and every queued-but-unflushed send, so no listener is orphaned
+  // (the latch invariant of the Python channel, channel.py _latch_error)
+  for (auto& ob : c->outq) {
+    if (ob.wr_id && ob.last_of_wr) {
+      Completion comp{};
+      comp.kind = COMP_SEND_DONE;
+      comp.status = ST_ERR;
+      comp.channel = c->id;
+      comp.wr_id = ob.wr_id;
+      n->post(comp);
+    }
+  }
+  c->outq.clear();
   Completion comp{};
   comp.kind = COMP_CHANNEL_DOWN;
   comp.channel = c->id;
@@ -240,6 +254,14 @@ void fail_conn(Node* n, Conn* c) {
   epoll_ctl(n->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
   c->fd = -1;
+  // retire the Conn: out of the id map now (commands will fail cleanly),
+  // freed at the next loop iteration so events already fetched in this
+  // epoll batch can still look at c->down safely
+  {
+    std::lock_guard<std::mutex> g(n->conn_mu);
+    n->conns.erase(c->id);
+  }
+  n->graveyard.push_back(c);
 }
 
 void queue_out(Node* n, Conn* c, std::vector<uint8_t> data, uint64_t wr_id,
@@ -307,7 +329,9 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
     std::lock_guard<std::mutex> g(n->reg_mu);
     for (auto& b : blocks) {
       auto it = n->regions.find((uint32_t)b[0]);
-      if (it == n->regions.end() || b[1] + b[2] > it->second.second) {
+      // overflow-safe bounds check: addr+len can wrap in uint64
+      if (it == n->regions.end() || b[1] > it->second.second ||
+          b[2] > it->second.second - b[1]) {
         std::string msg = "region resolve failed (mkey " +
                           std::to_string(b[0]) + ")";
         std::vector<uint8_t> out(1 + 8 + 4 + msg.size());
@@ -385,7 +409,13 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
           c->body_need = (size_t)load_be32(c->hdr + 8) * 16;
           c->body.resize(c->body_need);
           c->body_got = 0;
-          c->st = RxState::READQ_BLOCKS;
+          if (c->body_need == 0) {
+            // zero-block READ: answer an empty response immediately
+            serve_read(n, c, c->cur_req, {});
+            c->st = RxState::OP;
+          } else {
+            c->st = RxState::READQ_BLOCKS;
+          }
         } else if (c->st == RxState::READR_HDR) {
           uint64_t req = load_be64(c->hdr);
           uint64_t total = load_be64(c->hdr + 8);
@@ -423,7 +453,14 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
           c->body_need = load_be32(c->hdr + 8);
           c->body.resize(c->body_need);
           c->body_got = 0;
-          c->st = c->body_need ? RxState::READE_BODY : RxState::OP;
+          if (c->body_need == 0) {
+            // empty error message: still complete the pending read
+            c->st = RxState::READE_BODY;
+            handle_frame_ingest(n, c, c->body.data(), 0);
+            c->st = RxState::OP;
+          } else {
+            c->st = RxState::READE_BODY;
+          }
         } else {  // HELLO_HDR
           c->body_need = load_be16(c->hdr + 4);
           c->body.resize(c->body_need);
@@ -550,6 +587,8 @@ void loop_main(Node* n) {
   epoll_event evs[64];
   uint8_t buf[1 << 16];
   while (true) {
+    for (Conn* dead : n->graveyard) delete dead;
+    n->graveyard.clear();
     int k = epoll_wait(n->epfd, evs, 64, 100);
     if (k < 0) {
       if (errno == EINTR) continue;

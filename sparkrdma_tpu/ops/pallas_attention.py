@@ -107,7 +107,10 @@ def flash_attention(
     scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, max(8, s))
     block_k = min(block_k, max(8, s))
-    s_pad = int(math.ceil(s / max(block_q, block_k))) * max(block_q, block_k)
+    # the padded length must divide by BOTH block sizes, or kv blocks
+    # past s_pad//block_k would silently never be visited
+    lcm = math.lcm(block_q, block_k)
+    s_pad = int(math.ceil(s / lcm)) * lcm
 
     def prep(x):
         x = jnp.transpose(x, (0, 2, 1, 3))  # [B, H, S, D]

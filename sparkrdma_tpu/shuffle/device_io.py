@@ -114,44 +114,89 @@ class DeviceShuffleIO:
 
         out: Dict[int, List[DeviceBuffer]] = {}
         my_id = mgr.executor_id
+        # Each in-flight read OWNS its destination buffer through its
+        # completion listener: the buffer returns to the pool only once
+        # the transport is provably done writing into it (completion or
+        # channel latch) — never on a timeout racing a late payload.
         pending: List[Tuple[PartitionLocation, object, threading.Event, list]] = []
-        for loc in locations:
-            if loc.manager_id.executor_id == my_id:
-                # local short-circuit straight from the registered region
-                view = mgr.node.pd.resolve(
-                    loc.block.mkey, loc.block.address, loc.block.length
-                )
-                dev = self._dev.stage_bytes(bytes(view))
-                out.setdefault(loc.partition_id, []).append(dev)
-                continue
-            reg = mgr.buffer_manager.get(loc.block.length)
+
+        def start_read(loc, reg):
             done = threading.Event()
             errbox: list = []
+            lock = threading.Lock()
+            owner = {"who": "caller"}  # flipped to "listener" on abandon
+
+            def on_done(err=None):
+                if err is not None:
+                    errbox.append(err)
+                done.set()
+                with lock:
+                    # on_failure may legally fire more than once; recycle
+                    # exactly once
+                    recycle = owner["who"] == "listener" and not owner.get("recycled")
+                    if recycle:
+                        owner["recycled"] = True
+                if recycle:
+                    mgr.buffer_manager.put(reg)
+
+            def abandon_or_reclaim():
+                """Caller gives up: recycle now if the read already
+                completed, else hand ownership to the listener."""
+                with lock:
+                    if done.is_set():
+                        completed = True
+                    else:
+                        owner["who"] = "listener"
+                        completed = False
+                if completed:
+                    mgr.buffer_manager.put(reg)
+
             ch = mgr.get_channel_to(loc.manager_id)
             ch.read_in_queue(
-                FnListener(
-                    lambda _, d=done: d.set(),
-                    lambda e, d=done, b=errbox: (b.append(e), d.set()),
-                ),
+                FnListener(lambda _: on_done(), on_done),
                 [reg.view[: loc.block.length]],
                 [(loc.block.mkey, loc.block.address, loc.block.length)],
             )
-            pending.append((loc, reg, done, errbox))
+            return (loc, reg, done, errbox, abandon_or_reclaim)
 
-        for loc, reg, done, errbox in pending:
-            ok = done.wait(timeout_s)
-            if not ok or errbox:
-                reg.free()
-                err = errbox[0] if errbox else TimeoutError("fetch timed out")
-                raise FetchFailedError(
-                    loc.manager_id, shuffle_id, -1, loc.partition_id, str(err)
-                )
-            dev = self._dev.stage_bytes(
-                bytes(reg.view[: loc.block.length])
-            )
-            reg.free()
-            out.setdefault(loc.partition_id, []).append(dev)
-        return out
+        try:
+            for loc in locations:
+                if loc.manager_id.executor_id == my_id:
+                    # local short-circuit straight from the registered region
+                    view = mgr.node.pd.resolve(
+                        loc.block.mkey, loc.block.address, loc.block.length
+                    )
+                    dev = self._dev.stage_bytes(bytes(view))
+                    out.setdefault(loc.partition_id, []).append(dev)
+                    continue
+                reg = mgr.buffer_manager.get(loc.block.length)
+                pending.append(start_read(loc, reg))
+
+            for i, (loc, reg, done, errbox, _abandon) in enumerate(pending):
+                ok = done.wait(timeout_s)
+                if not ok or errbox:
+                    err = errbox[0] if errbox else TimeoutError("fetch timed out")
+                    raise FetchFailedError(
+                        loc.manager_id, shuffle_id, -1, loc.partition_id, str(err)
+                    )
+                dev = self._dev.stage_bytes(bytes(reg.view[: loc.block.length]))
+                mgr.buffer_manager.put(reg)  # pooled reuse, not a cold free
+                pending[i] = None
+                out.setdefault(loc.partition_id, []).append(dev)
+            return out
+        except Exception:
+            # release everything: staged device slabs are freed here;
+            # each unconsumed destination buffer is recycled atomically
+            # by whichever side (caller / completion listener) turns out
+            # to be its last owner
+            for bufs in out.values():
+                for dev in bufs:
+                    dev.free()
+            for entry in pending:
+                if entry is None:
+                    continue
+                entry[4]()  # abandon_or_reclaim
+            raise
 
     # ------------------------------------------------------------------
     def unpublish(self, shuffle_id: int) -> None:

@@ -500,14 +500,27 @@ class NativeTpuNode:
             return
         self._stopped.set()
         self._cq_thread.join(timeout=2.0)
-        # fail anything still outstanding (latch semantics)
         with self._lock:
-            wrs = list(self._wrs.items())
-            self._wrs.clear()
             channels = list(self._channels.values())
             self._channels.clear()
         for ch in channels:
             ch._dead.set()
+        # teardown order matters twice over: pooled buffers deregister
+        # their regions through the native node (so it must be alive for
+        # buffer_manager.stop), and the epoll loop may still be streaming
+        # READ payloads into destination buffers referenced only by _wrs
+        # keepalives — so the loop must be FULLY joined (srt_node_stop)
+        # before those references are dropped
+        self.buffer_manager.stop()
+        self.pd.dealloc()
+        np_handle, self._np = self._np, None
+        if np_handle:
+            self._lib.srt_node_stop(np_handle)
+        # loop is dead now: fail anything still outstanding (latch
+        # semantics) and release the keepalives
+        with self._lock:
+            wrs = list(self._wrs.items())
+            self._wrs.clear()
         err = ChannelError("node stopped")
         for _, (listener, _keep) in wrs:
             if listener is not None:
@@ -515,10 +528,3 @@ class NativeTpuNode:
                     listener.on_failure(err)
                 except Exception:
                     logger.exception("listener on_failure raised")
-        # teardown order matters: pooled buffers deregister their regions
-        # through the native node, so it must still be alive here
-        self.buffer_manager.stop()
-        self.pd.dealloc()
-        np_handle, self._np = self._np, None
-        if np_handle:
-            self._lib.srt_node_stop(np_handle)

@@ -190,3 +190,58 @@ def test_peer_loss_detected_natively():
         assert pruned, "driver did not prune lost native peer"
     finally:
         driver.stop()
+
+
+def test_peer_death_fails_send_and_read_listeners():
+    """Regression: a dying peer must fail every outstanding WR listener
+    (queued sends included) — never orphan them."""
+    import time
+
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "death-a")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "death-b")
+    ch = a.get_channel("127.0.0.1", b.port)
+    src = memoryview(bytes(1024))
+    mkey = b.pd.register(src)
+    b.stop()  # peer dies
+
+    failures = []
+    fired = threading.Event()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        ch.send_in_queue(
+            FnListener(None, lambda e: (failures.append(e), fired.set())),
+            [b"late"],
+        )
+        if fired.wait(0.3):
+            break
+    assert fired.is_set(), "send listener orphaned after peer death"
+    a.stop()
+
+
+def test_read_bounds_wraparound_rejected():
+    """Regression: addr+len overflow in the native bounds check must be
+    rejected as a remote error, not served from a wild pointer."""
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "wrap-a")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "wrap-b")
+    try:
+        src = memoryview(bytes(1024))
+        mkey = a.pd.register(src)
+        ch = b.get_channel("127.0.0.1", a.port)
+        failures = []
+        fired = threading.Event()
+        ch.read_in_queue(
+            FnListener(None, lambda e: (failures.append(e), fired.set())),
+            [memoryview(bytearray(32))],
+            [(mkey, (1 << 64) - 16, 32)],
+        )
+        assert fired.wait(5), "wraparound read neither failed nor completed"
+        assert "READ failed" in str(failures[0]) or "resolve" in str(failures[0])
+    finally:
+        b.stop()
+        a.stop()

@@ -38,3 +38,12 @@ def test_flash_multi_kv_blocks_online_softmax():
     out = flash_attention(q, k, v, block_q=64, block_k=32)
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_mismatched_block_sizes_cover_full_kv():
+    """Regression: s_pad must divide by BOTH block sizes, or tail kv
+    blocks are silently never attended."""
+    q, k, v = _inputs(s=128)
+    out = flash_attention(q, k, v, block_q=128, block_k=96)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
