@@ -1,0 +1,140 @@
+"""Executor worker process — `python -m sparkrdma_tpu.engine.worker`.
+
+The reference's process topology is one endpoint per *JVM*: executors
+are separate processes that register with the driver and serve/pull
+shuffle blocks over the network (SURVEY.md §1 "Process topology").
+This module is that executor process for the TPU framework: it owns a
+full `TpuShuffleManager` (transport endpoint, registered memory,
+writers/readers) plus a small task server through which the driver
+dispatches map/reduce closures (the Spark-core role the reference
+delegates to Spark; closures travel via cloudpickle).
+
+Task protocol (length-prefixed cloudpickle, one request per
+connection): {"kind": "map" | "reduce" | "finalize" | "ping" | "stop",
+...} -> {"ok": bool, "result"/"error": ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import struct
+import sys
+import threading
+import traceback
+
+import cloudpickle
+
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+_LEN = struct.Struct(">I")
+
+
+def _recv_obj(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return cloudpickle.loads(bytes(buf))
+
+
+def _send_obj(sock: socket.socket, obj) -> None:
+    data = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+class Worker:
+    def __init__(self, conf: TpuShuffleConf, executor_id: str):
+        self.manager = TpuShuffleManager(conf, is_driver=False, executor_id=executor_id)
+        self.manager.start_node_if_missing()  # hello to driver now
+        self._stop = threading.Event()
+
+    def handle(self, req):
+        kind = req["kind"]
+        if kind == "ping":
+            return {"ok": True, "result": "pong"}
+        if kind == "map":
+            handle = req["handle"]
+            writer = self.manager.get_writer(handle, req["map_id"])
+            try:
+                writer.write(req["records_fn"]())
+                writer.stop(True)
+            except Exception:
+                writer.stop(False)
+                raise
+            return {"ok": True}
+        if kind == "finalize":
+            self.manager.finalize_maps(req["shuffle_id"])
+            return {"ok": True}
+        if kind == "reduce":
+            handle = req["handle"]
+            reader = self.manager.get_reader(handle, req["start"], req["end"])
+            it = reader.read()
+            fn = req.get("reduce_fn")
+            result = fn(it) if fn is not None else list(it)
+            return {"ok": True, "result": result}
+        if kind == "stop":
+            self._stop.set()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown task kind {kind!r}"}
+
+    def serve(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(16)
+        srv.settimeout(0.2)
+        # announce the task port to the parent (driver) on stdout
+        print(f"WORKER_PORT {srv.getsockname()[1]}", flush=True)
+
+        def one(conn):
+            try:
+                req = _recv_obj(conn)
+                try:
+                    resp = self.handle(req)
+                except Exception as e:
+                    resp = {
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                        "traceback": traceback.format_exc(),
+                    }
+                _send_obj(conn, resp)
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=one, args=(conn,), daemon=True).start()
+        srv.close()
+        self.manager.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--executor-id", required=True)
+    ap.add_argument("--conf", required=True, help="JSON dict of tpu.shuffle.* keys")
+    args = ap.parse_args()
+    conf = TpuShuffleConf(json.loads(args.conf))
+    Worker(conf, args.executor_id).serve()
+
+
+if __name__ == "__main__":
+    main()
