@@ -48,10 +48,16 @@ class DeviceBuffer:
 
     ``length`` is the caller-requested byte length; ``capacity`` the
     size-class slab length actually resident. ``array`` always has
-    shape [capacity] dtype uint8.
+    shape [capacity] dtype uint8 while device-resident; under HBM
+    budget pressure a buffer may be **spilled** to host RAM (the
+    HBM -> host tier of the tiered shuffle store, SURVEY.md §7.3-4)
+    and transparently restored on next device use.
     """
 
-    __slots__ = ("handle", "capacity", "length", "array", "_manager")
+    __slots__ = (
+        "handle", "capacity", "length", "array", "_manager", "_host",
+        "last_use",
+    )
 
     def __init__(self, handle: int, capacity: int, array, manager):
         self.handle = handle
@@ -59,21 +65,49 @@ class DeviceBuffer:
         self.length = 0
         self.array = array
         self._manager = manager
+        self._host: Optional[np.ndarray] = None  # set while spilled
+        self.last_use = 0
+
+    @property
+    def spilled(self) -> bool:
+        return self._host is not None
 
     @property
     def device(self):
-        return next(iter(self.array.devices()))
+        if self.array is not None:
+            return next(iter(self.array.devices()))
+        return self._manager.device
+
+    def spill_to_host(self) -> None:
+        """HBM -> host RAM; releases device budget, keeps the handle."""
+        if self._host is not None:
+            return
+        self._host = np.asarray(self.array)
+        self.array.delete()
+        self.array = None
+        self._manager._on_spill(self)
+
+    def ensure_device(self) -> "DeviceBuffer":
+        """Restore a spilled buffer to HBM (may spill others to fit)."""
+        if self._host is None:
+            return self
+        self._manager._reserve_for_restore(self)
+        host, self._host = self._host, None
+        self.array = jax.device_put(host, self._manager.device)
+        return self
 
     def stage(self, data: bytes) -> "DeviceBuffer":
         """Host -> HBM: replace the slab contents (pads to capacity)."""
         if len(data) > self.capacity:
             raise ValueError(f"{len(data)}B exceeds slab capacity {self.capacity}B")
+        self.ensure_device()
         host = np.zeros((self.capacity,), dtype=np.uint8)
         host[: len(data)] = np.frombuffer(data, dtype=np.uint8)
         old = self.array
         self.array = jax.device_put(host, self.device)
         old.delete()
         self.length = len(data)
+        self._manager._touch(self)
         return self
 
     def put_array(self, arr) -> "DeviceBuffer":
@@ -82,20 +116,25 @@ class DeviceBuffer:
             raise ValueError("slab contents must be 1-D uint8")
         if arr.shape[0] > self.capacity:
             raise ValueError("array exceeds slab capacity")
+        self.ensure_device()
         self.length = arr.shape[0]
         old = self.array
         if arr.shape[0] < self.capacity:
             arr = jnp.zeros((self.capacity,), dtype=jnp.uint8).at[: arr.shape[0]].set(arr)
         self.array = arr
         old.delete()
+        self._manager._touch(self)
         return self
 
     def read(self, offset: int = 0, length: Optional[int] = None) -> bytes:
-        """HBM -> host readback of ``[offset, offset+length)``."""
+        """Readback of ``[offset, offset+length)`` from either tier."""
         if length is None:
             length = self.length - offset
         if offset < 0 or length < 0 or offset + length > self.capacity:
             raise ValueError("read out of slab bounds")
+        if self._host is not None:
+            return self._host[offset : offset + length].tobytes()
+        self._manager._touch(self)
         return np.asarray(self.array[offset : offset + length]).tobytes()
 
     def free(self) -> None:
@@ -129,6 +168,8 @@ class DeviceBufferManager:
         self._handles: Dict[int, DeviceBuffer] = {}
         self._next_handle = 1
         self._in_use_bytes = 0
+        self._use_clock = 0
+        self._spill_count = 0
         self._lock = threading.Lock()
         self._stopped = False
         # optional warm-up (reference maxAggPrealloc, RdmaBufferManager.java:84-91)
@@ -138,25 +179,78 @@ class DeviceBufferManager:
                 b.free()
 
     # ------------------------------------------------------------------
+    # HBM <-> host tiering (SURVEY.md §7.3-4). Tier moves synchronize on
+    # buffer state loosely: concurrent spill/restore of the SAME buffer
+    # is the caller's race to avoid; budget arithmetic itself is locked.
+    def _touch(self, buf: DeviceBuffer) -> None:
+        with self._lock:
+            self._use_clock += 1
+            buf.last_use = self._use_clock
+
+    def _on_spill(self, buf: DeviceBuffer) -> None:
+        with self._lock:
+            self._in_use_bytes -= buf.capacity
+            self._spill_count += 1
+
+    def _pick_spill_victim(self, exclude_handle: int) -> Optional[DeviceBuffer]:
+        with self._lock:
+            candidates = [
+                b
+                for b in self._handles.values()
+                if b.handle != exclude_handle and not b.spilled and b.array is not None
+            ]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda b: b.last_use)
+
+    def _make_room(self, cls: int, exclude_handle: int = -1) -> None:
+        """Spill LRU device-resident buffers until ``cls`` bytes fit."""
+        while True:
+            with self._lock:
+                if not self.max_bytes or self._in_use_bytes + cls <= self.max_bytes:
+                    return
+            victim = self._pick_spill_victim(exclude_handle)
+            if victim is None:
+                with self._lock:
+                    in_use = self._in_use_bytes
+                raise MemoryError(
+                    f"HBM shuffle budget exceeded: in-use {in_use}B + {cls}B "
+                    f"> cap {self.max_bytes}B and nothing left to spill"
+                )
+            victim.spill_to_host()
+
+    def _reserve_for_restore(self, buf: DeviceBuffer) -> None:
+        self._make_room(buf.capacity, exclude_handle=buf.handle)
+        with self._lock:
+            self._in_use_bytes += buf.capacity
+            self._use_clock += 1
+            buf.last_use = self._use_clock
+
     def get(self, nbytes: int) -> DeviceBuffer:
-        """Allocate (or reuse) a slab whose class covers ``nbytes``."""
+        """Allocate (or reuse) a slab whose class covers ``nbytes``.
+
+        Under budget pressure, least-recently-used live slabs spill to
+        host RAM first; MemoryError only when nothing is spillable."""
         cls = _size_class(nbytes)
         with self._lock:
             if self._stopped:
                 raise RuntimeError("DeviceBufferManager is stopped")
             stack = self._stacks.setdefault(cls, _AllocatorStack(cls))
             stack.total_gets += 1
-            if stack.stack:
-                buf = stack.stack.pop()
-                buf.length = nbytes
+            pooled = stack.stack.pop() if stack.stack else None
+            if pooled is not None:
+                pooled.length = nbytes
                 self._in_use_bytes += cls
-                self._handles[buf.handle] = buf
-                return buf
-            if self.max_bytes and self._in_use_bytes + cls > self.max_bytes:
-                raise MemoryError(
-                    f"HBM shuffle budget exceeded: in-use {self._in_use_bytes}B "
-                    f"+ {cls}B > cap {self.max_bytes}B"
-                )
+                self._handles[pooled.handle] = pooled
+                self._use_clock += 1
+                pooled.last_use = self._use_clock
+        if pooled is not None:
+            # the pooled slab re-enters the budget: spill LRU others if
+            # that pushed us over the cap
+            self._make_room(0, exclude_handle=pooled.handle)
+            return pooled
+        self._make_room(cls)
+        with self._lock:
             handle = self._next_handle
             self._next_handle += 1
             stack.total_alloc += 1
@@ -166,6 +260,8 @@ class DeviceBufferManager:
         buf.length = nbytes
         with self._lock:
             self._handles[handle] = buf
+            self._use_clock += 1
+            buf.last_use = self._use_clock
         return buf
 
     def put(self, buf: DeviceBuffer) -> None:
@@ -173,6 +269,11 @@ class DeviceBufferManager:
         with self._lock:
             if self._handles.pop(buf.handle, None) is None:
                 return  # double-free tolerated, like onFailure reentry
+            if buf.spilled:
+                # spilled slabs released their device budget already and
+                # have no device array to pool — just drop the host copy
+                buf._host = None
+                return
             self._in_use_bytes -= buf.capacity
             if self._stopped:
                 buf.array.delete()
@@ -197,6 +298,11 @@ class DeviceBufferManager:
     def in_use_bytes(self) -> int:
         with self._lock:
             return self._in_use_bytes
+
+    @property
+    def spill_count(self) -> int:
+        with self._lock:
+            return self._spill_count
 
     def stats(self) -> Dict[int, Dict[str, int]]:
         with self._lock:
@@ -228,4 +334,6 @@ class DeviceBufferManager:
             s.stack.clear()
         for buf in leaked:
             logger.warning("hbm slab handle %d leaked (freeing)", buf.handle)
-            buf.array.delete()
+            if buf.array is not None:
+                buf.array.delete()
+            buf._host = None

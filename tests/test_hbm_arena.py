@@ -57,16 +57,19 @@ def test_handle_table_resolution():
     mgr.stop()
 
 
-def test_budget_enforced():
+def test_budget_enforced_device_residency():
+    """The budget caps DEVICE residency: allocations beyond it demote
+    LRU slabs to the host tier rather than failing."""
     mgr = DeviceBufferManager(max_bytes=MIN_BLOCK_SIZE * 2)
     a = mgr.get(1)
     b = mgr.get(1)
-    with pytest.raises(MemoryError):
-        mgr.get(1)
+    c = mgr.get(1)  # over cap: a (LRU) demotes to host
+    assert a.spilled
+    assert mgr.in_use_bytes <= MIN_BLOCK_SIZE * 2
     a.free()
-    c = mgr.get(1)  # freed capacity is available again
     b.free()
     c.free()
+    assert mgr.in_use_bytes == 0
     mgr.stop()
 
 
@@ -76,4 +79,59 @@ def test_double_free_tolerated():
     buf.free()
     buf.free()  # like RdmaCompletionListener.onFailure: reentry tolerated
     assert mgr.in_use_bytes == 0
+    mgr.stop()
+
+
+def test_budget_pressure_spills_lru_to_host():
+    """SURVEY §7.3-4 tiering: over-budget allocation spills the
+    least-recently-used live slab to host RAM instead of failing."""
+    mgr = DeviceBufferManager(max_bytes=MIN_BLOCK_SIZE * 2)
+    a = mgr.get(1)
+    a.stage(b"oldest")
+    b = mgr.get(1)
+    b.stage(b"newer")
+    c = mgr.get(1)  # budget full: LRU (a) must spill, not MemoryError
+    assert a.spilled and not b.spilled and not c.spilled
+    assert mgr.spill_count == 1
+    assert a.read(0, 6) == b"oldest"  # readable from the host tier
+    c.free()
+    a.ensure_device()  # restore fits after c freed
+    assert not a.spilled
+    assert a.read(0, 6) == b"oldest"
+    a.free()
+    b.free()
+    mgr.stop()
+
+
+def test_restore_spills_someone_else():
+    mgr = DeviceBufferManager(max_bytes=MIN_BLOCK_SIZE * 2)
+    a = mgr.get(1); a.stage(b"aa")
+    b = mgr.get(1); b.stage(b"bb")
+    c = mgr.get(1); c.stage(b"cc")   # spills a
+    assert a.spilled
+    a.ensure_device()                 # must spill the new LRU (b)
+    assert not a.spilled and b.spilled
+    assert b.read(0, 2) == b"bb"
+    for x in (a, b, c):
+        x.free()
+    mgr.stop()
+
+
+def test_spilled_buffer_free_is_clean():
+    mgr = DeviceBufferManager(max_bytes=MIN_BLOCK_SIZE)
+    a = mgr.get(1); a.stage(b"x")
+    b = mgr.get(1)   # spills a
+    assert a.spilled
+    a.free()         # freeing a spilled slab must not touch the budget
+    assert mgr.in_use_bytes == b.capacity
+    b.free()
+    assert mgr.in_use_bytes == 0
+    mgr.stop()
+
+
+def test_nothing_spillable_raises():
+    # cap smaller than one size class: no victim can ever make room
+    mgr = DeviceBufferManager(max_bytes=MIN_BLOCK_SIZE // 2)
+    with pytest.raises(MemoryError):
+        mgr.get(1)
     mgr.stop()
