@@ -54,6 +54,7 @@ class ShuffleMetrics:
     remote_bytes: int = 0
     fetch_wait_ms: float = 0.0
     records_read: int = 0
+    sort_spills: int = 0  # external-sorter runs spilled to scratch
 
 
 @dataclass

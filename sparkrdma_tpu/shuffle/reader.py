@@ -60,5 +60,12 @@ class TpuShuffleReader:
             )
             records = iter(combined.items())
         if self._handle.key_ordering:
-            records = iter(sorted(records, key=lambda kv: kv[0]))
+            # spillable ordering (the ExternalSorter role, :99-112)
+            from sparkrdma_tpu.utils.external_sorter import ExternalSorter
+
+            sorter = ExternalSorter(
+                spill_threshold=self._manager.conf.sort_spill_threshold
+            )
+            records = sorter.sort(records)
+            self._fetcher.metrics.sort_spills = sorter.spill_count
         return records

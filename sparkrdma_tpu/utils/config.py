@@ -216,6 +216,13 @@ class TpuShuffleConf:
         """Timeout for driver location fetches (fetcher iterator wrapper)."""
         return self._int("partitionLocationFetchTimeoutMs", 30000, 100, 1 << 30)
 
+    # -- reduce-side ordering ---------------------------------------------
+    @property
+    def sort_spill_threshold(self) -> int:
+        """Records held in memory before the reader's external sorter
+        spills a sorted run to scratch (the ExternalSorter role)."""
+        return self._int("reader.sortSpillThreshold", 1 << 20, 1024, 1 << 31)
+
     # -- transport selection ----------------------------------------------
     @property
     def transport(self) -> str:
