@@ -56,6 +56,31 @@ def _tp_copy_bwd(_, ct):
 _tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
 
 
+@jax.custom_vjp
+def _tp_psum(x):
+    """Megatron's "g" operator: all-reduce forward, identity backward.
+
+    Conjugate of :func:`_tp_copy`. A bare ``lax.psum`` cannot be used
+    here: under ``shard_map(check_vma=False)`` psum transposes to psum,
+    so the row-parallel matmul's cotangent would arrive multiplied by
+    the tp group size (the replicated downstream cotangent gets summed
+    over tp), scaling the w1/w2 gradients by exactly ``tp``. The
+    correct adjoint of "replicated ct through an all-reduce" is the
+    identity — each tp shard already holds the full cotangent."""
+    return jax.lax.psum(x, "tp")
+
+
+def _tp_psum_fwd(x):
+    return jax.lax.psum(x, "tp"), None
+
+
+def _tp_psum_bwd(_, ct):
+    return (ct,)
+
+
+_tp_psum.defvjp(_tp_psum_fwd, _tp_psum_bwd)
+
+
 def make_training_mesh(devices=None) -> Mesh:
     """(dp, sp, tp) mesh over 8+ devices (2x2x2 at 8)."""
     if devices is None:
@@ -150,26 +175,34 @@ class TransformerStep:
             # Megatron MLP: column-parallel w1, row-parallel w2; the
             # _tp_copy/psum pair is the f/g conjugate operator pair
             hcol = jax.nn.gelu(_tp_copy(x) @ params["w1"])  # [bl, sl, H/tp]
-            mlp = jax.lax.psum(hcol @ params["w2"], "tp")
+            mlp = _tp_psum(hcol @ params["w2"])
             return x + mlp
 
+        # global element count is static: every (dp, sp) shard holds an
+        # equal tile of the [b, s, d] batch
+        n_shards = mesh.shape["dp"] * mesh.shape["sp"]
+
         def train_shard(params, x, y):
+            # The differentiated function must return the LOCAL loss
+            # contribution (no dp/sp psum inside): under
+            # check_vma=False psum transposes to psum, so a psum'd loss
+            # seeds every shard with the full group cotangent and the
+            # explicit psum(grads) below would then double-count by a
+            # factor of dp*sp. Sum-reduce local grads/losses AFTER the
+            # backward instead.
             def loss_fn(p):
                 out = forward_local(p, x)
-                sq = ((out - y) ** 2).sum()
-                total = jax.lax.psum(sq, ("dp", "sp"))
-                count = jax.lax.psum(
-                    jnp.asarray(out.size, jnp.float32), ("dp", "sp")
-                )
-                return total / count
+                return ((out - y) ** 2).sum()
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            gcount = jnp.asarray(x.size * n_shards, jnp.float32)
+            sq, grads = jax.value_and_grad(loss_fn)(params)
+            loss = jax.lax.psum(sq, ("dp", "sp")) / gcount
             # cross-shard reduction: every param's grad sums over dp+sp;
             # tp-sharded params keep their local slice, replicated params
             # computed identical grads on every tp shard (x replicated on
             # tp), so no tp reduction is needed for either kind
             grads = jax.tree.map(
-                lambda g: jax.lax.psum(g, ("dp", "sp")), grads
+                lambda g: jax.lax.psum(g, ("dp", "sp")) / gcount, grads
             )
             new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
             return loss, new

@@ -39,10 +39,12 @@ def test_sharded_step_matches_reference():
     ref_loss, ref_new = reference_step(
         {k: jnp.asarray(v) for k, v in params.items()}, x, y, n_heads=4, lr=0.1
     )
-    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    # exact math on a CPU f32 mesh: the sharded backward must agree with
+    # the single-device reference to float rounding, not just "roughly"
     for k in params:
         np.testing.assert_allclose(
-            np.asarray(new[k]), np.asarray(ref_new[k]), rtol=2e-3, atol=2e-5,
+            np.asarray(new[k]), np.asarray(ref_new[k]), rtol=1e-5, atol=1e-7,
             err_msg=f"param {k}",
         )
 
