@@ -21,11 +21,84 @@ yielding the ``mkey`` used by remote one-sided READs.
 
 from __future__ import annotations
 
+import atexit
 import mmap
+import os
+import secrets
+import threading
 from typing import Optional
 
 from sparkrdma_tpu.memory.registry import ProtectionDomain
 from sparkrdma_tpu.native.arena import NativeArena, native_arena_available
+
+# Registered buffers are backed by /dev/shm files when possible so the
+# native transport can advertise a (path, offset) same-host fast path
+# (peers pread the bytes from page cache instead of streaming them).
+# Unguessable names prevent cross-host path collisions: a peer that can
+# open the path IS on this host. Files unlink on free() and at normal
+# exit (atexit). atexit does NOT run on SIGKILL/OOM, so names embed the
+# owning pid and every import sweeps files whose owner is gone — a
+# crashed executor's slabs are reclaimed by the next one on the host.
+_SHM_DIR = "/dev/shm"
+_shm_files: set = set()
+_shm_lock = threading.Lock()
+
+
+def _sweep_shm_files() -> None:
+    with _shm_lock:
+        leftover = list(_shm_files)
+        _shm_files.clear()
+    for path in leftover:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def sweep_stale_shm_files() -> int:
+    """Unlink srt shm files (buffer slabs + native host-proof tokens)
+    whose owning process no longer exists. Returns the count removed."""
+    removed = 0
+    try:
+        names = os.listdir(_SHM_DIR)
+    except OSError:
+        return 0
+    for name in names:
+        pid = None
+        if name.startswith("srt-host-"):
+            parts = name.split("-")  # srt-host-<pid>-<hex>
+            if len(parts) >= 4 and parts[2].isdigit():
+                pid = int(parts[2])
+        elif name.startswith("srt-"):
+            parts = name.split("-")  # srt-<pid>-<hex>
+            if len(parts) >= 3 and parts[1].isdigit():
+                pid = int(parts[1])
+        if pid is None or pid == os.getpid() or _pid_alive(pid):
+            continue
+        try:
+            os.unlink(os.path.join(_SHM_DIR, name))
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
+atexit.register(_sweep_shm_files)
+sweep_stale_shm_files()
+
+
+def _shm_usable() -> bool:
+    return os.path.isdir(_SHM_DIR) and os.access(_SHM_DIR, os.W_OK)
 
 
 class TpuBuffer:
@@ -40,12 +113,44 @@ class TpuBuffer:
     ):
         if length <= 0:
             raise ValueError(f"buffer length must be positive, got {length}")
+        if register and pd is None:
+            # validate before allocating: a failed constructor must not
+            # leave an shm file behind (free() never runs on it)
+            raise ValueError("registration requested but no ProtectionDomain")
         self.length = length
         self._arena: Optional[NativeArena] = None
         self._mmap: Optional[mmap.mmap] = None
+        self._shm_path: Optional[str] = None
         if arena and native_arena_available():
             self._arena = NativeArena.shared()
             self._alloc_id, view = self._arena.alloc(length)
+        elif (
+            register
+            and getattr(pd, "supports_file_regions", False)
+            and _shm_usable()
+        ):
+            path = os.path.join(
+                _SHM_DIR, f"srt-{os.getpid()}-{secrets.token_hex(16)}"
+            )
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                # posix_fallocate actually reserves tmpfs pages (ENOSPC
+                # now) where a sparse ftruncate would SIGBUS on first
+                # write past a small container /dev/shm
+                os.posix_fallocate(fd, 0, length)
+                self._mmap = mmap.mmap(fd, length, mmap.MAP_SHARED)
+                os.close(fd)
+            except OSError:
+                os.close(fd)
+                os.unlink(path)
+                # fall back to anonymous memory (no fast path, no SIGBUS)
+                self._mmap = mmap.mmap(-1, length)
+                path = None
+            self._shm_path = path
+            if path is not None:
+                with _shm_lock:
+                    _shm_files.add(path)
+            view = memoryview(self._mmap)
         else:
             self._mmap = mmap.mmap(-1, length)
             view = memoryview(self._mmap)
@@ -53,9 +158,9 @@ class TpuBuffer:
         self._pd = pd
         self.mkey = 0
         if register:
-            if pd is None:
-                raise ValueError("registration requested but no ProtectionDomain")
-            self.mkey = pd.register(view)
+            self.mkey = pd.register(
+                view, file_path=self._shm_path, file_offset=0
+            )
         self._freed = False
 
     # -- accessors --------------------------------------------------------
@@ -111,6 +216,14 @@ class TpuBuffer:
                 # until they die — leak-safe, never use-after-free
                 pass
             self._mmap = None
+        if self._shm_path is not None:
+            with _shm_lock:
+                _shm_files.discard(self._shm_path)
+            try:
+                os.unlink(self._shm_path)
+            except OSError:
+                pass
+            self._shm_path = None
 
     def __len__(self) -> int:
         return self.length

@@ -117,7 +117,12 @@ class MappedFile:
                 self._fd, map_len, mmap.MAP_SHARED, mmap.PROT_READ, offset=aligned_start
             )
             view = memoryview(mm)
-            mkey = self._pd.register(view)
+            # advertise the backing file so same-host peers can pread
+            # the chunk from page cache instead of streaming it
+            mkey = self._pd.register(
+                view, file_path=os.path.abspath(self.path),
+                file_offset=aligned_start,
+            )
             mapping_index = len(self._mappings)
             self._mappings.append(_FileMapping(mm, view, mkey, aligned_start, map_len))
             for pid in chunk:

@@ -26,6 +26,11 @@ class RegionError(KeyError):
 class ProtectionDomain:
     """Handle table: mkey → registered memoryview."""
 
+    # the pure-Python plane streams every READ; it never consumes
+    # file_path hints, so buffers should not bother allocating shm
+    # backing for it (NativeProtectionDomain overrides this)
+    supports_file_regions = False
+
     _next_pd_id = 0
     _pd_lock = threading.Lock()
 
@@ -37,8 +42,20 @@ class ProtectionDomain:
         self._regions: Dict[int, memoryview] = {}
         self._next_mkey = 1  # 0 reserved as "unregistered"
 
-    def register(self, view: memoryview) -> int:
-        """Register a memory region (read-only is fine); returns its mkey."""
+    def register(
+        self,
+        view: memoryview,
+        file_path: Optional[str] = None,
+        file_offset: int = 0,
+    ) -> int:
+        """Register a memory region (read-only is fine); returns its mkey.
+
+        ``file_path``/``file_offset`` describe a file whose bytes mirror
+        the region (shm slab, mapped shuffle file). The pure-Python
+        plane streams all READs and ignores them; the native plane uses
+        them for the same-host pread fast path (transport.cpp
+        srt_reg_file)."""
+        del file_path, file_offset  # python plane always streams
         with self._lock:
             mkey = self._next_mkey
             self._next_mkey += 1

@@ -37,10 +37,12 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <stdint.h>
+#include <stdio.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
 
@@ -65,6 +67,20 @@ constexpr uint8_t OP_READ_RESP = 3;
 constexpr uint8_t OP_READ_ERR = 4;
 constexpr uint8_t OP_HELLO = 5;
 constexpr uint8_t OP_GOODBYE = 6;
+// READ_REQ2: same layout as READ_REQ but announces the requester can
+// read the server's files directly (same-host fast path). The server
+// may answer READ_FILE instead of streaming READ_RESP when every block
+// resolves to a file-backed region. The READ_FILE body leads with the
+// server's host-proof path (an unguessable /dev/shm name); a client
+// that cannot stat it is on another host and falls back to streaming,
+// so colliding file paths across hosts can never serve wrong bytes.
+// Wire v2 ops: both planes in this repo accept REQ2 (the Python plane
+// streams); there is no cross-version negotiation with older binaries.
+//   READ_REQ2 = op(1) req_id(8) n(4) then n x [mkey(4) addr(8) len(4)]
+//   READ_FILE = op(1) req_id(8) body_len(4) body
+//     body    = proof_len(2) proof_path n(4) then n x [file_off(8) plen(2) path]
+constexpr uint8_t OP_READ_REQ2 = 9;
+constexpr uint8_t OP_READ_FILE = 10;
 
 constexpr uint32_t COMP_SEND_DONE = 1;
 constexpr uint32_t COMP_READ_DONE = 2;
@@ -109,6 +125,12 @@ struct OutBuf {
   size_t pos = 0;
   uint64_t wr_id = 0;    // nonzero: emit SEND_DONE when fully written
   bool last_of_wr = false;
+  // zero-copy payload: when ext != nullptr the bytes are sent straight
+  // from the registered region (pinned under pin_mkey) — the NIC-DMA
+  // analogue of serving an RDMA READ without touching the data
+  const uint8_t* ext = nullptr;
+  uint64_t ext_len = 0;
+  uint32_t pin_mkey = 0;
 };
 
 struct PendingRead {
@@ -116,6 +138,10 @@ struct PendingRead {
   uint8_t* dst;
   uint64_t expected;
   uint64_t received = 0;
+  // original request blocks: kept for the same-host file path (per-
+  // block pread placement) and for re-posting a plain READ_REQ when a
+  // READ_FILE answer turns out not to be readable from here
+  std::vector<std::array<uint64_t, 3>> blocks;
 };
 
 // incremental frame-parser states
@@ -125,6 +151,7 @@ enum class RxState {
   READQ_HDR, READQ_BLOCKS,
   READR_HDR, READR_BODY, READR_DRAIN,
   READE_HDR, READE_BODY,
+  READF_HDR, READF_BODY,
   HELLO_HDR, HELLO_BODY,
 };
 
@@ -144,13 +171,22 @@ struct Conn {
   size_t body_need = 0, body_got = 0;
   uint64_t cur_req = 0;
   uint64_t drain_left = 0;
+  bool cur_req2 = false;            // server: READ_REQ2 (file-capable peer)
   PendingRead* cur_read = nullptr;  // owned by reads map
 
   std::unordered_map<uint64_t, PendingRead> reads;  // req_id -> pending
+
+  // same-host fast-path state (client side): -1 unknown, 0 proven not
+  // same-host (proof stat failed — permanent for this conn), 1 proven.
+  // Transient file errors do NOT latch 0; they just stream that read.
+  int files_ok = -1;
 };
 
 struct Command {
-  enum Kind { ADD_CONN, SEND, READ, CLOSE_CONN, STOP } kind;
+  enum Kind {
+    ADD_CONN, SEND, READ, CLOSE_CONN, EVICT_MKEY,
+    FILE_DONE, FILE_FALLBACK, STOP
+  } kind;
   uint64_t channel = 0;
   int fd = -1;
   bool outbound = false;
@@ -162,6 +198,17 @@ struct Command {
   uint64_t req_id = 0;
   uint8_t* dst = nullptr;
   uint64_t expected = 0;
+  std::vector<std::array<uint64_t, 3>> blocks;
+};
+
+// one same-host pread job, executed on the file worker thread so a
+// cold-cache disk read can never head-of-line block the epoll loop
+struct FileTask {
+  uint64_t channel = 0;
+  uint64_t req_id = 0;
+  uint8_t* dst = nullptr;
+  std::vector<uint64_t> lens;
+  std::vector<std::pair<std::string, uint64_t>> files;  // path, file_off
 };
 
 struct Node {
@@ -171,9 +218,34 @@ struct Node {
   uint16_t port = 0;
   std::thread loop;
   std::atomic<bool> stopping{false};
+  // host-identity proof for the same-host file fast path: an
+  // unguessably-named empty file in /dev/shm. Its path rides in every
+  // READ_FILE answer; a client that can stat it shares this host's
+  // filesystem, so advertised backing-file paths are meaningful. This
+  // is what prevents a deterministic shuffle-file path (same layout on
+  // every host) from being opened on the WRONG host and silently
+  // serving that host's bytes.
+  std::string host_proof;
 
+  struct Region {
+    const uint8_t* ptr = nullptr;
+    uint64_t len = 0;
+    // pins: queued zero-copy sends referencing this memory. Dereg of a
+    // pinned region BLOCKS until its last queued byte is flushed (the
+    // MR-invalidation-ordering guarantee the reference gets from verbs:
+    // memory may be reclaimed by the caller as soon as dereg returns)
+    uint32_t pins = 0;
+    bool dereg_wanted = false;
+    // file backing (shm slab or mapped shuffle file): lets a same-host
+    // peer pread the bytes straight from page cache instead of
+    // streaming them through the socket
+    std::string path;
+    uint64_t file_off = 0;
+    bool file_backed = false;
+  };
   std::mutex reg_mu;
-  std::unordered_map<uint32_t, std::pair<const uint8_t*, uint64_t>> regions;
+  std::condition_variable reg_cv;
+  std::unordered_map<uint32_t, Region> regions;
   uint32_t next_mkey = 1;
 
   std::mutex cq_mu;
@@ -187,6 +259,17 @@ struct Node {
   std::unordered_map<uint64_t, Conn*> conns;
   uint64_t next_conn = 1;
   std::vector<Conn*> graveyard;  // loop-thread-only: dead conns awaiting free
+
+  // file worker: executes same-host preads off the epoll loop.
+  // file_pending is loop-thread-only: a PendingRead parks here while
+  // its task is with the worker, so a dying Conn cannot free it
+  // mid-pread and the destination keepalive stays owned until a
+  // completion is posted.
+  std::thread file_worker;
+  std::mutex ft_mu;
+  std::condition_variable ft_cv;
+  std::deque<FileTask> ftq;
+  std::map<std::pair<uint64_t, uint64_t>, PendingRead> file_pending;
 
   void post(Completion c) {
     {
@@ -214,6 +297,32 @@ int set_nonblock(int fd) {
   return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
 }
 
+// large socket buffers + no Nagle: the data plane moves 8 MiB READ
+// groups; default loopback buffers throttle the pipeline hard
+void tune_socket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int sz = 4 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
+
+// release one zero-copy pin; completes a deferred dereg at pin zero
+void unpin_region(Node* n, uint32_t mkey) {
+  bool erased = false;
+  {
+    std::lock_guard<std::mutex> g(n->reg_mu);
+    auto it = n->regions.find(mkey);
+    if (it == n->regions.end()) return;
+    if (it->second.pins > 0) it->second.pins--;
+    if (it->second.pins == 0 && it->second.dereg_wanted) {
+      n->regions.erase(it);
+      erased = true;
+    }
+  }
+  if (erased) n->reg_cv.notify_all();
+}
+
 void arm(Node* n, Conn* c) {
   epoll_event ev{};
   ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
@@ -237,6 +346,7 @@ void fail_conn(Node* n, Conn* c) {
   // ...and every queued-but-unflushed send, so no listener is orphaned
   // (the latch invariant of the Python channel, channel.py _latch_error)
   for (auto& ob : c->outq) {
+    if (ob.ext) unpin_region(n, ob.pin_mkey);
     if (ob.wr_id && ob.last_of_wr) {
       Completion comp{};
       comp.kind = COMP_SEND_DONE;
@@ -291,9 +401,10 @@ void queue_out(Node* n, Conn* c, std::vector<uint8_t> data, uint64_t wr_id,
 void flush_out(Node* n, Conn* c) {
   while (!c->outq.empty()) {
     OutBuf& ob = c->outq.front();
-    while (ob.pos < ob.data.size()) {
-      ssize_t w = send(c->fd, ob.data.data() + ob.pos, ob.data.size() - ob.pos,
-                       MSG_NOSIGNAL);
+    const uint8_t* base = ob.ext ? ob.ext : ob.data.data();
+    const size_t size = ob.ext ? (size_t)ob.ext_len : ob.data.size();
+    while (ob.pos < size) {
+      ssize_t w = send(c->fd, base + ob.pos, size - ob.pos, MSG_NOSIGNAL);
       if (w > 0) {
         ob.pos += (size_t)w;
       } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
@@ -303,6 +414,7 @@ void flush_out(Node* n, Conn* c) {
         return;
       }
     }
+    if (ob.ext) unpin_region(n, ob.pin_mkey);
     if (ob.wr_id && ob.last_of_wr) {
       Completion comp{};
       comp.kind = COMP_SEND_DONE;
@@ -320,7 +432,11 @@ void flush_out(Node* n, Conn* c) {
 }
 
 // serve a one-sided READ_REQ entirely in native code: resolve each
-// (mkey, addr, len) block against the registry and queue the response
+// (mkey, addr, len) block against the registry, pin the regions, and
+// queue zero-copy responses sent straight out of registered memory —
+// no per-byte application copy, the NIC-DMA analogue. A concurrent
+// dereg of a pinned region blocks until its bytes are flushed
+// (verbs MR-invalidation ordering, RdmaBuffer.java:81-88).
 void serve_read(Node* n, Conn* c, uint64_t req_id,
                 const std::vector<std::array<uint64_t, 3>>& blocks) {
   uint64_t total = 0;
@@ -330,8 +446,8 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
     for (auto& b : blocks) {
       auto it = n->regions.find((uint32_t)b[0]);
       // overflow-safe bounds check: addr+len can wrap in uint64
-      if (it == n->regions.end() || b[1] > it->second.second ||
-          b[2] > it->second.second - b[1]) {
+      if (it == n->regions.end() || it->second.dereg_wanted ||
+          b[1] > it->second.len || b[2] > it->second.len - b[1]) {
         std::string msg = "region resolve failed (mkey " +
                           std::to_string(b[0]) + ")";
         std::vector<uint8_t> out(1 + 8 + 4 + msg.size());
@@ -342,22 +458,176 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
         queue_out(n, c, std::move(out), 0, false);
         return;
       }
-      views.emplace_back(it->second.first + b[1], b[2]);
+      views.emplace_back(it->second.ptr + b[1], b[2]);
       total += b[2];
     }
-    // copy under the registry lock: a concurrent dereg cannot race the
-    // memcpy (the reference relies on MR invalidation ordering instead)
-    std::vector<uint8_t> out(1 + 8 + 8 + total);
-    out[0] = OP_READ_RESP;
-    store_be64(&out[1], req_id);
-    store_be64(&out[9], total);
-    size_t off = 17;
-    for (auto& v : views) {
-      memcpy(&out[off], v.first, v.second);
-      off += v.second;
-    }
-    queue_out(n, c, std::move(out), 0, false);
+    // pin while still under the lock so no dereg can slip between
+    // resolution and enqueue
+    for (auto& b : blocks) n->regions[(uint32_t)b[0]].pins++;
   }
+  std::vector<uint8_t> hdr(1 + 8 + 8);
+  hdr[0] = OP_READ_RESP;
+  store_be64(&hdr[1], req_id);
+  store_be64(&hdr[9], total);
+  queue_out(n, c, std::move(hdr), 0, false);
+  if (c->down) {
+    // queue_out dropped the header; drop the pins too
+    for (auto& b : blocks) unpin_region(n, (uint32_t)b[0]);
+    return;
+  }
+  for (size_t i = 0; i < blocks.size(); i++) {
+    OutBuf ob;
+    ob.ext = views[i].first;
+    ob.ext_len = views[i].second;
+    ob.pin_mkey = (uint32_t)blocks[i][0];
+    c->outq.push_back(std::move(ob));
+  }
+  if (!c->want_write && !blocks.empty()) {
+    c->want_write = true;
+    arm(n, c);
+  }
+  // push what the socket will take right away rather than waiting a
+  // poll cycle
+  if (!c->down) flush_out(n, c);
+}
+
+// READ_REQ2 from a file-capable peer: when every block resolves to a
+// file-backed region, answer with (path, offset) metadata instead of
+// bytes — the peer preads straight from page cache. Falls back to the
+// streaming serve_read otherwise.
+void serve_read2(Node* n, Conn* c, uint64_t req_id,
+                 const std::vector<std::array<uint64_t, 3>>& blocks) {
+  std::vector<std::pair<std::string, uint64_t>> files;
+  if (!n->host_proof.empty()) {
+    std::lock_guard<std::mutex> g(n->reg_mu);
+    for (auto& b : blocks) {
+      auto it = n->regions.find((uint32_t)b[0]);
+      if (it == n->regions.end() || it->second.dereg_wanted ||
+          b[1] > it->second.len || b[2] > it->second.len - b[1] ||
+          !it->second.file_backed) {
+        files.clear();
+        break;
+      }
+      files.emplace_back(it->second.path, it->second.file_off + b[1]);
+    }
+  }
+  if (files.empty() || blocks.empty()) {
+    serve_read(n, c, req_id, blocks);  // mixed/unbacked/invalid: stream
+    return;
+  }
+  size_t body_len = 2 + n->host_proof.size() + 4;
+  for (auto& f : files) body_len += 8 + 2 + f.first.size();
+  if (body_len > (2u << 20)) {
+    // the client hard-fails READ_FILE bodies over 4 MiB as malformed;
+    // an enormous block count is better served by streaming anyway
+    serve_read(n, c, req_id, blocks);
+    return;
+  }
+  std::vector<uint8_t> out(1 + 8 + 4 + body_len);
+  out[0] = OP_READ_FILE;
+  store_be64(&out[1], req_id);
+  store_be32(&out[9], (uint32_t)body_len);
+  size_t off = 13;
+  out[off] = (uint8_t)(n->host_proof.size() >> 8);
+  out[off + 1] = (uint8_t)(n->host_proof.size() & 0xff);
+  memcpy(&out[off + 2], n->host_proof.data(), n->host_proof.size());
+  off += 2 + n->host_proof.size();
+  store_be32(&out[off], (uint32_t)files.size());
+  off += 4;
+  for (auto& f : files) {
+    store_be64(&out[off], f.second);
+    out[off + 8] = (uint8_t)(f.first.size() >> 8);
+    out[off + 9] = (uint8_t)(f.first.size() & 0xff);
+    memcpy(&out[off + 10], f.first.data(), f.first.size());
+    off += 10 + f.first.size();
+  }
+  queue_out(n, c, std::move(out), 0, false);
+  if (!c->down) flush_out(n, c);
+}
+
+// (re)send a READ request frame for an already-registered PendingRead.
+// use_file_op selects READ_REQ2 (file-capable) vs plain READ_REQ.
+void send_read_frame(Node* n, Conn* c, uint64_t req_id,
+                     const std::vector<std::array<uint64_t, 3>>& blocks,
+                     bool use_file_op) {
+  std::vector<uint8_t> frame(1 + 8 + 4 + blocks.size() * 16);
+  frame[0] = use_file_op ? OP_READ_REQ2 : OP_READ_REQ;
+  store_be64(&frame[1], req_id);
+  store_be32(&frame[9], (uint32_t)blocks.size());
+  for (size_t i = 0; i < blocks.size(); i++) {
+    uint8_t* b = &frame[13 + i * 16];
+    store_be32(b, (uint32_t)blocks[i][0]);
+    store_be64(b + 4, blocks[i][1]);
+    store_be32(b + 12, (uint32_t)blocks[i][2]);
+  }
+  queue_out(n, c, std::move(frame), 0, false);
+  if (!c->down) flush_out(n, c);
+}
+
+// same-host pread execution, on the file worker thread. The fd cache
+// is worker-private; cached fds are revalidated against the current
+// inode so a recreated shuffle file at the same path is never read
+// through a stale fd (an unlinked file's fd would serve old bytes).
+bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
+  uint64_t dst_off = 0;
+  for (size_t i = 0; i < t.files.size(); i++) {
+    uint64_t len = t.lens[i];
+    struct stat st;
+    if (stat(t.files[i].first.c_str(), &st) != 0) return false;
+    int fd = -1;
+    auto it = fd_cache.find(t.files[i].first);
+    if (it != fd_cache.end()) {
+      struct stat fst;
+      if (fstat(it->second, &fst) == 0 && fst.st_dev == st.st_dev &&
+          fst.st_ino == st.st_ino) {
+        fd = it->second;
+      } else {
+        close(it->second);  // unlinked/recreated: drop the stale fd
+        fd_cache.erase(it);
+      }
+    }
+    if (fd < 0) {
+      fd = open(t.files[i].first.c_str(), O_RDONLY);
+      if (fd < 0) return false;
+      if (fd_cache.size() >= 64) {
+        // bound the cache: never pin unlinked tmpfs inodes (and fds)
+        // for the process lifetime
+        for (auto& kv : fd_cache) close(kv.second);
+        fd_cache.clear();
+      }
+      fd_cache[t.files[i].first] = fd;
+    }
+    uint64_t got = 0;
+    while (got < len) {
+      ssize_t r = pread(fd, t.dst + dst_off + got, (size_t)(len - got),
+                        (off_t)(t.files[i].second + got));
+      if (r <= 0) return false;
+      got += (uint64_t)r;
+    }
+    dst_off += len;
+  }
+  return true;
+}
+
+void file_worker_main(Node* n) {
+  std::unordered_map<std::string, int> fd_cache;
+  while (true) {
+    FileTask t;
+    {
+      std::unique_lock<std::mutex> lk(n->ft_mu);
+      n->ft_cv.wait(lk, [&] { return !n->ftq.empty() || n->stopping.load(); });
+      if (n->ftq.empty()) break;  // stopping and drained
+      t = std::move(n->ftq.front());
+      n->ftq.pop_front();
+    }
+    bool ok = do_file_task(t, fd_cache);
+    Command cmd;
+    cmd.kind = ok ? Command::FILE_DONE : Command::FILE_FALLBACK;
+    cmd.channel = t.channel;
+    cmd.req_id = t.req_id;
+    n->enqueue(std::move(cmd));
+  }
+  for (auto& kv : fd_cache) close(kv.second);
 }
 
 void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len);
@@ -372,9 +642,15 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         c->hdr_got = 0;
         switch (op) {
           case OP_SEND: c->st = RxState::SEND_HDR; c->hdr_need = 4; break;
-          case OP_READ_REQ: c->st = RxState::READQ_HDR; c->hdr_need = 12; break;
+          case OP_READ_REQ:
+            c->cur_req2 = false;
+            c->st = RxState::READQ_HDR; c->hdr_need = 12; break;
+          case OP_READ_REQ2:
+            c->cur_req2 = true;
+            c->st = RxState::READQ_HDR; c->hdr_need = 12; break;
           case OP_READ_RESP: c->st = RxState::READR_HDR; c->hdr_need = 16; break;
           case OP_READ_ERR: c->st = RxState::READE_HDR; c->hdr_need = 12; break;
+          case OP_READ_FILE: c->st = RxState::READF_HDR; c->hdr_need = 12; break;
           case OP_HELLO: c->st = RxState::HELLO_HDR; c->hdr_need = 6; break;
           case OP_GOODBYE: fail_conn(n, c); return used;
           default: fail_conn(n, c); return used;
@@ -385,6 +661,7 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
       case RxState::READQ_HDR:
       case RxState::READR_HDR:
       case RxState::READE_HDR:
+      case RxState::READF_HDR:
       case RxState::HELLO_HDR: {
         size_t take = std::min(len - used, c->hdr_need - c->hdr_got);
         memcpy(c->hdr + c->hdr_got, data + used, take);
@@ -461,6 +738,16 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
           } else {
             c->st = RxState::READE_BODY;
           }
+        } else if (c->st == RxState::READF_HDR) {
+          c->cur_req = load_be64(c->hdr);
+          c->body_need = load_be32(c->hdr + 8);
+          if (c->body_need == 0 || c->body_need > (4u << 20)) {
+            fail_conn(n, c);  // malformed READ_FILE
+            return used;
+          }
+          c->body.resize(c->body_need);
+          c->body_got = 0;
+          c->st = RxState::READF_BODY;
         } else {  // HELLO_HDR
           c->body_need = load_be16(c->hdr + 4);
           c->body.resize(c->body_need);
@@ -484,6 +771,7 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
       case RxState::SEND_BODY:
       case RxState::READQ_BLOCKS:
       case RxState::READE_BODY:
+      case RxState::READF_BODY:
       case RxState::HELLO_BODY: {
         size_t take = std::min(len - used, c->body_need - c->body_got);
         memcpy(c->body.data() + c->body_got, data + used, take);
@@ -545,7 +833,76 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         const uint8_t* b = data + i * 16;
         blocks[i] = {load_be32(b), load_be64(b + 4), load_be32(b + 12)};
       }
-      serve_read(n, c, c->cur_req, blocks);
+      if (c->cur_req2)
+        serve_read2(n, c, c->cur_req, blocks);
+      else
+        serve_read(n, c, c->cur_req, blocks);
+      break;
+    }
+    case RxState::READF_BODY: {
+      auto it = c->reads.find(c->cur_req);
+      if (it == c->reads.end()) break;  // late/unknown: nothing to do
+      // parse proof_len(2) proof_path then n x [file_off(8) plen(2) path]
+      std::vector<std::pair<std::string, uint64_t>> files;
+      bool parsed = len >= 2;
+      bool same_host = false;
+      size_t off = 0;
+      if (parsed) {
+        uint16_t prooflen = load_be16(data);
+        parsed = (size_t)2 + prooflen + 4 <= len && prooflen > 0;
+        if (parsed) {
+          // host-identity gate: the proof path is unguessable, so being
+          // able to stat it proves we share the server's filesystem.
+          // Without this, a deterministic shuffle-file path existing on
+          // BOTH hosts would silently serve the wrong host's bytes.
+          std::string proof((const char*)data + 2, prooflen);
+          struct stat st;
+          same_host = stat(proof.c_str(), &st) == 0;
+          off = 2 + prooflen;
+        }
+      }
+      if (parsed && same_host) {
+        uint32_t nf = load_be32(data + off);
+        off += 4;
+        parsed = false;
+        if (nf == it->second.blocks.size()) {
+          parsed = true;
+          for (uint32_t i = 0; parsed && i < nf; i++) {
+            if (off + 10 > len) { parsed = false; break; }
+            uint64_t foff = load_be64(data + off);
+            uint16_t plen = load_be16(data + off + 8);
+            if (off + 10 + plen > len) { parsed = false; break; }
+            files.emplace_back(
+                std::string((const char*)data + off + 10, plen), foff);
+            off += 10 + plen;
+          }
+        }
+      }
+      if (parsed && same_host) {
+        // hand the preads to the file worker; the pending read parks in
+        // the node-level map so this Conn's death cannot free it while
+        // the worker is writing into its destination
+        c->files_ok = 1;
+        FileTask t;
+        t.channel = c->id;
+        t.req_id = c->cur_req;
+        t.dst = it->second.dst;
+        for (auto& b : it->second.blocks) t.lens.push_back(b[2]);
+        t.files = std::move(files);
+        n->file_pending.emplace(std::make_pair(c->id, c->cur_req),
+                                std::move(it->second));
+        c->reads.erase(it);
+        {
+          std::lock_guard<std::mutex> g(n->ft_mu);
+          n->ftq.push_back(std::move(t));
+        }
+        n->ft_cv.notify_one();
+      } else {
+        // different host (proof unreachable): latch the fast path off
+        // for this conn. A malformed frame just streams this one read.
+        if (parsed && !same_host) c->files_ok = 0;
+        send_read_frame(n, c, c->cur_req, it->second.blocks, false);
+      }
       break;
     }
     case RxState::READE_BODY: {
@@ -585,7 +942,9 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
 
 void loop_main(Node* n) {
   epoll_event evs[64];
-  uint8_t buf[1 << 16];
+  std::vector<uint8_t> bufv(1 << 18);  // per-loop staging for headers/RPC
+  uint8_t* buf = bufv.data();
+  const size_t buf_sz = bufv.size();
   while (true) {
     for (Conn* dead : n->graveyard) delete dead;
     n->graveyard.clear();
@@ -609,7 +968,33 @@ void loop_main(Node* n) {
             cmd = std::move(n->cmds.front());
             n->cmds.pop_front();
           }
-          if (cmd.kind == Command::STOP) return;
+          if (cmd.kind == Command::STOP) {
+            // fail every live conn FIRST: this releases all zero-copy
+            // pins (unblocking any dereg waiter safely) and fails all
+            // outstanding reads/sends before the loop dies
+            std::vector<Conn*> live;
+            {
+              std::lock_guard<std::mutex> g(n->conn_mu);
+              for (auto& kv : n->conns) live.push_back(kv.second);
+            }
+            for (Conn* v : live) fail_conn(n, v);
+            // parked file-pending reads complete as errors
+            for (auto& kv : n->file_pending) {
+              Completion comp{};
+              comp.kind = COMP_READ_DONE;
+              comp.status = ST_ERR;
+              comp.channel = kv.first.first;
+              comp.wr_id = kv.second.wr_id;
+              n->post(comp);
+            }
+            n->file_pending.clear();
+            // fail_conn pushed every conn into the graveyard; the
+            // normal top-of-loop sweep will never run again, so free
+            // them here (srt_node_stop only frees what's in n->conns)
+            for (Conn* dead : n->graveyard) delete dead;
+            n->graveyard.clear();
+            return;
+          }
           Conn* c = nullptr;
           {
             std::lock_guard<std::mutex> g(n->conn_mu);
@@ -646,14 +1031,65 @@ void loop_main(Node* n) {
               pr.wr_id = cmd.wr_id;
               pr.dst = cmd.dst;
               pr.expected = cmd.expected;
-              c->reads.emplace(cmd.req_id, pr);
-              queue_out(n, c, std::move(cmd.data), 0, false);
-              if (!c->down) flush_out(n, c);
+              pr.blocks = cmd.blocks;
+              c->reads.emplace(cmd.req_id, std::move(pr));
+              // first try the same-host file path unless this channel
+              // already proved the peer's files unreachable
+              send_read_frame(n, c, cmd.req_id, cmd.blocks,
+                              c->files_ok != 0);
             }
           } else if (cmd.kind == Command::CLOSE_CONN && c) {
             // flush what we can, then drop
             if (!c->down) flush_out(n, c);
             fail_conn(n, c);
+          } else if (cmd.kind == Command::EVICT_MKEY) {
+            // a dereg timed out on this mkey's pins: kill every conn
+            // still holding queued zero-copy sends from it (fail_conn
+            // unpins), so the blocked dereg can complete safely
+            uint32_t mk = (uint32_t)cmd.req_id;
+            std::vector<Conn*> victims;
+            {
+              std::lock_guard<std::mutex> g(n->conn_mu);
+              for (auto& kv : n->conns) {
+                for (auto& ob : kv.second->outq) {
+                  if (ob.ext && ob.pin_mkey == mk) {
+                    victims.push_back(kv.second);
+                    break;
+                  }
+                }
+              }
+            }
+            for (Conn* v : victims) fail_conn(n, v);
+          } else if (cmd.kind == Command::FILE_DONE ||
+                     cmd.kind == Command::FILE_FALLBACK) {
+            auto key = std::make_pair(cmd.channel, cmd.req_id);
+            auto fit = n->file_pending.find(key);
+            if (fit != n->file_pending.end()) {
+              PendingRead pr = std::move(fit->second);
+              n->file_pending.erase(fit);
+              if (cmd.kind == Command::FILE_DONE) {
+                Completion comp{};
+                comp.kind = COMP_READ_DONE;
+                comp.status = ST_OK;
+                comp.channel = cmd.channel;
+                comp.wr_id = pr.wr_id;
+                n->post(comp);
+              } else if (c && !c->down) {
+                // transient file failure: stream THIS read; the conn's
+                // files_ok latch is untouched (only a host-proof miss
+                // disables the fast path permanently)
+                c->reads.emplace(cmd.req_id, std::move(pr));
+                auto rit = c->reads.find(cmd.req_id);
+                send_read_frame(n, c, cmd.req_id, rit->second.blocks, false);
+              } else {
+                Completion comp{};
+                comp.kind = COMP_READ_DONE;
+                comp.status = ST_ERR;
+                comp.channel = cmd.channel;
+                comp.wr_id = pr.wr_id;
+                n->post(comp);
+              }
+            }
           }
         }
         continue;
@@ -662,8 +1098,7 @@ void loop_main(Node* n) {
         while (true) {
           int fd = accept4(n->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
           if (fd < 0) break;
-          int one = 1;
-          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          tune_socket(fd);
           Conn* c = new Conn();
           c->fd = fd;
           {
@@ -688,7 +1123,37 @@ void loop_main(Node* n) {
       if (c->down) continue;
       if (evs[i].events & EPOLLIN) {
         while (true) {
-          ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+          // mid-READ-payload: receive straight into the caller's
+          // destination buffer — one kernel->user copy, no staging
+          if (c->st == RxState::READR_BODY && c->cur_read) {
+            PendingRead* pr = c->cur_read;
+            size_t want = (size_t)(pr->expected - pr->received);
+            ssize_t r = recv(c->fd, pr->dst + pr->received, want, 0);
+            if (r > 0) {
+              pr->received += (uint64_t)r;
+              if (pr->received == pr->expected) {
+                Completion comp{};
+                comp.kind = COMP_READ_DONE;
+                comp.status = ST_OK;
+                comp.channel = c->id;
+                comp.wr_id = pr->wr_id;
+                n->post(comp);
+                c->reads.erase(c->cur_req);
+                c->cur_read = nullptr;
+                c->st = RxState::OP;
+              }
+              continue;
+            } else if (r == 0) {
+              fail_conn(n, c);
+              break;
+            } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+              break;
+            } else {
+              fail_conn(n, c);
+              break;
+            }
+          }
+          ssize_t r = recv(c->fd, buf, buf_sz, 0);
           if (r > 0) {
             size_t used = 0;
             while (used < (size_t)r && !c->down)
@@ -761,7 +1226,30 @@ void* srt_node_create(const char* host, uint16_t base_port, int max_retries) {
   ev.events = EPOLLIN;
   ev.data.ptr = &n->evfd;
   epoll_ctl(n->epfd, EPOLL_CTL_ADD, n->evfd, &ev);
+  // host-identity proof for the same-host file fast path (see the
+  // READ_FILE wire comment): 128 random bits from /dev/urandom. The
+  // pid in the name lets the Python-side sweeper reclaim proofs of
+  // crashed processes (atexit never runs on SIGKILL/OOM).
+  {
+    uint8_t rnd[16];
+    int ufd = open("/dev/urandom", O_RDONLY);
+    if (ufd >= 0 && read(ufd, rnd, sizeof(rnd)) == (ssize_t)sizeof(rnd)) {
+      char name[96];
+      size_t pos = 0;
+      pos += snprintf(name, sizeof(name), "/dev/shm/srt-host-%d-",
+                      (int)getpid());
+      for (int i = 0; i < 16; i++)
+        pos += snprintf(name + pos, sizeof(name) - pos, "%02x", rnd[i]);
+      int pfd = open(name, O_CREAT | O_EXCL | O_WRONLY, 0644);
+      if (pfd >= 0) {
+        close(pfd);
+        n->host_proof = name;
+      }
+    }
+    if (ufd >= 0) close(ufd);
+  }
   n->loop = std::thread(loop_main, n);
+  n->file_worker = std::thread(file_worker_main, n);
   return n;
 }
 
@@ -772,14 +1260,70 @@ uint32_t srt_reg(void* np, const void* ptr, uint64_t len) {
   Node* n = (Node*)np;
   std::lock_guard<std::mutex> g(n->reg_mu);
   uint32_t mkey = n->next_mkey++;
-  n->regions[mkey] = {(const uint8_t*)ptr, len};
+  Node::Region r;
+  r.ptr = (const uint8_t*)ptr;
+  r.len = len;
+  n->regions[mkey] = r;
+  return mkey;
+}
+
+// register a region whose bytes are identical to [file_off, file_off+len)
+// of the file at `path` (an shm slab or a mapped shuffle file): same-host
+// peers may pread it directly instead of streaming through the socket
+uint32_t srt_reg_file(void* np, const void* ptr, uint64_t len,
+                      const char* path, uint64_t file_off) {
+  Node* n = (Node*)np;
+  std::lock_guard<std::mutex> g(n->reg_mu);
+  uint32_t mkey = n->next_mkey++;
+  Node::Region r;
+  r.ptr = (const uint8_t*)ptr;
+  r.len = len;
+  r.path = path ? path : "";
+  r.file_off = file_off;
+  r.file_backed = path && path[0];
+  n->regions[mkey] = r;
   return mkey;
 }
 
 int srt_dereg(void* np, uint32_t mkey) {
   Node* n = (Node*)np;
-  std::lock_guard<std::mutex> g(n->reg_mu);
-  return n->regions.erase(mkey) ? 0 : -1;
+  std::unique_lock<std::mutex> lk(n->reg_mu);
+  auto it = n->regions.find(mkey);
+  if (it == n->regions.end()) return -1;
+  if (it->second.pins == 0) {
+    n->regions.erase(it);
+    return 0;
+  }
+  // Zero-copy sends are in flight from this memory: block until the
+  // loop thread flushes them (caller may free the memory on return —
+  // the verbs ibv_dereg_mr contract). A peer that stops draining its
+  // socket could hold the pin forever, so after a grace period the
+  // offending connections are killed (the QP-error analogue), which
+  // releases the pins. Never erase while pinned — that would let the
+  // caller unmap memory the loop is still send()ing from.
+  it->second.dereg_wanted = true;
+  auto gone = [&] { return n->regions.find(mkey) == n->regions.end(); };
+  // NOTE: `stopping` is deliberately NOT a wake-to-erase condition —
+  // between the flag being set and the loop thread processing STOP,
+  // queued zero-copy sends can still flush from this memory. Progress
+  // is guaranteed instead: a live loop either drains the pins, or the
+  // EVICT below kills the holding conns (unpinning), or STOP's
+  // fail-all-conns unpins; each path erases the region and notifies.
+  if (!n->reg_cv.wait_for(lk, std::chrono::seconds(5), gone)) {
+    lk.unlock();
+    Command cmd;
+    cmd.kind = Command::EVICT_MKEY;
+    cmd.req_id = mkey;  // reuse the field; EVICT has no req semantics
+    n->enqueue(std::move(cmd));
+    lk.lock();
+  }
+  if (!n->reg_cv.wait_for(lk, std::chrono::seconds(30), gone)) {
+    // loop thread dead or wedged: leak the region entry rather than
+    // risk a use-after-free. dereg_wanted stays set, so no future
+    // serve can resolve this mkey.
+    return -1;
+  }
+  return 0;
 }
 
 uint64_t srt_region_count(void* np) {
@@ -823,8 +1367,7 @@ uint64_t srt_connect(void* np, const char* host, uint16_t port,
     if (w <= 0) { close(fd); return 0; }
     off += (size_t)w;
   }
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  tune_socket(fd);
   set_nonblock(fd);
   Conn* c = new Conn();
   c->fd = fd;
@@ -868,27 +1411,21 @@ int srt_post_read(void* np, uint64_t channel, uint64_t wr_id, void* dst,
                   const uint64_t* blocks, uint32_t n_blocks) {
   Node* n = (Node*)np;
   uint64_t total = 0;
-  std::vector<uint8_t> frame(1 + 8 + 4 + (size_t)n_blocks * 16);
-  frame[0] = OP_READ_REQ;
-  store_be32(&frame[9], n_blocks);
+  std::vector<std::array<uint64_t, 3>> blks(n_blocks);
   for (uint32_t i = 0; i < n_blocks; i++) {
-    uint8_t* b = &frame[13 + (size_t)i * 16];
-    store_be32(b, (uint32_t)blocks[i * 3]);
-    store_be64(b + 4, blocks[i * 3 + 1]);
-    store_be32(b + 12, (uint32_t)blocks[i * 3 + 2]);
+    blks[i] = {blocks[i * 3], blocks[i * 3 + 1], blocks[i * 3 + 2]};
     total += blocks[i * 3 + 2];
   }
   static std::atomic<uint64_t> next_req{1};
   uint64_t req_id = next_req.fetch_add(1);
-  store_be64(&frame[1], req_id);
   Command cmd;
   cmd.kind = Command::READ;
   cmd.channel = channel;
-  cmd.data = std::move(frame);
   cmd.wr_id = wr_id;
   cmd.req_id = req_id;
   cmd.dst = (uint8_t*)dst;
   cmd.expected = total;
+  cmd.blocks = std::move(blks);
   n->enqueue(std::move(cmd));
   return 0;
 }
@@ -932,10 +1469,16 @@ void srt_node_stop(void* np) {
   Node* n = (Node*)np;
   bool was = n->stopping.exchange(true);
   if (was) return;
+  n->reg_cv.notify_all();  // release any dereg waiting on pinned sends
+  if (!n->host_proof.empty()) unlink(n->host_proof.c_str());
   Command cmd;
   cmd.kind = Command::STOP;
   n->enqueue(std::move(cmd));
   n->loop.join();
+  // the worker drains queued tasks (their destination buffers stay
+  // alive until this function returns), then exits on `stopping`
+  n->ft_cv.notify_all();
+  if (n->file_worker.joinable()) n->file_worker.join();
   close(n->listen_fd);
   {
     std::lock_guard<std::mutex> g(n->conn_mu);

@@ -77,6 +77,11 @@ def load() -> Optional[ctypes.CDLL]:
         lib.srt_node_port.argtypes = [ctypes.c_void_p]
         lib.srt_reg.restype = ctypes.c_uint32
         lib.srt_reg.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.srt_reg_file.restype = ctypes.c_uint32
+        lib.srt_reg_file.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64,
+        ]
         lib.srt_dereg.restype = ctypes.c_int
         lib.srt_dereg.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.srt_region_count.restype = ctypes.c_uint64

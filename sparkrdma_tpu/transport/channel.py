@@ -231,7 +231,9 @@ class TpuChannel:
                     payload = wire.read_exact(self._sock, n)
                     if self._on_recv is not None:
                         self._on_recv(self, payload)
-                elif op == wire.OP_READ_REQ:
+                elif op == wire.OP_READ_REQ or op == wire.OP_READ_REQ2:
+                    # REQ2 (a native file-capable peer) gets the same
+                    # streamed READ_RESP: this plane has no file path
                     self._serve_read()
                 elif op == wire.OP_READ_RESP:
                     self._complete_read()

@@ -48,16 +48,33 @@ class NativeProtectionDomain:
     one-sided READs are served entirely natively) and mirrors it in a
     Python dict so local consumers can still ``resolve`` views."""
 
+    supports_file_regions = True  # file hints feed the same-host pread path
+
     def __init__(self, node: "NativeTpuNode"):
         self._node = node
         self._mirror: Dict[int, memoryview] = {}
         self._lock = threading.Lock()
 
-    def register(self, view: memoryview) -> int:
+    def register(
+        self,
+        view: memoryview,
+        file_path: Optional[str] = None,
+        file_offset: int = 0,
+    ) -> int:
+        """Register a region; when ``file_path`` names a file whose
+        bytes at ``file_offset`` are identical to the region (an shm
+        slab or a mapped shuffle file), same-host peers serve READs by
+        pread-ing it straight from page cache instead of streaming."""
         np_handle = self._node._np
         if not np_handle:
             raise RuntimeError("native node stopped; cannot register regions")
-        mkey = tl.load().srt_reg(np_handle, _addr_of(view), len(view))
+        if file_path:
+            mkey = tl.load().srt_reg_file(
+                np_handle, _addr_of(view), len(view),
+                file_path.encode(), file_offset,
+            )
+        else:
+            mkey = tl.load().srt_reg(np_handle, _addr_of(view), len(view))
         with self._lock:
             self._mirror[mkey] = view
         return mkey

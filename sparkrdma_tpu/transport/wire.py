@@ -28,6 +28,12 @@ OP_READ_RESP = 3
 OP_READ_ERR = 4
 OP_HELLO = 5
 OP_GOODBYE = 6
+# READ_REQ2 (native plane): identical layout to READ_REQ, but announces
+# the requester can pread the server's backing files directly (same-host
+# fast path). A pure-Python server treats it exactly like READ_REQ and
+# streams a READ_RESP — never OP_READ_FILE — preserving wire interop.
+OP_READ_REQ2 = 9
+OP_READ_FILE = 10
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")

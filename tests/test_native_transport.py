@@ -245,3 +245,117 @@ def test_read_bounds_wraparound_rejected():
     finally:
         b.stop()
         a.stop()
+
+
+def test_same_host_file_fast_path():
+    """shm-backed registered buffers are served via the same-host pread
+    fast path (READ_REQ2 -> READ_FILE): data must be byte-identical and
+    the streamed fallback must still work for anonymous regions."""
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "fp-srv")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "fp-cli")
+    try:
+        buf = TpuBuffer(a.pd, 1 << 20, register=True)
+        assert buf._shm_path is not None, "pool buffer should be shm-backed"
+        import numpy as np
+
+        src = np.random.default_rng(7).integers(
+            0, 256, size=1 << 20, dtype=np.uint8
+        )
+        np.frombuffer(buf.view, dtype=np.uint8)[:] = src
+
+        ch = b.get_channel("127.0.0.1", a.port)
+        dst = memoryview(bytearray(65536))
+        done = threading.Event()
+        errs = []
+        ch.read_in_queue(
+            FnListener(lambda _: done.set(), lambda e: (errs.append(e), done.set())),
+            [dst],
+            [(buf.mkey, 12345, 65536)],
+        )
+        assert done.wait(5), errs
+        assert not errs, errs
+        assert bytes(dst) == src[12345 : 12345 + 65536].tobytes()
+
+        # multi-block read spanning file-backed + file-backed
+        dst2 = [memoryview(bytearray(1000)), memoryview(bytearray(2000))]
+        done2 = threading.Event()
+        ch.read_in_queue(
+            FnListener(lambda _: done2.set(), lambda e: (errs.append(e), done2.set())),
+            dst2,
+            [(buf.mkey, 0, 1000), (buf.mkey, 500000, 2000)],
+        )
+        assert done2.wait(5), errs
+        assert not errs, errs
+        assert bytes(dst2[0]) == src[:1000].tobytes()
+        assert bytes(dst2[1]) == src[500000:502000].tobytes()
+
+        # anonymous region on the same channel: server must fall back to
+        # streaming (mixed region kinds never corrupt)
+        anon = memoryview(bytes(range(256)) * 16)
+        mkey2 = a.pd.register(anon)
+        dst3 = memoryview(bytearray(4096))
+        done3 = threading.Event()
+        ch.read_in_queue(
+            FnListener(lambda _: done3.set(), lambda e: (errs.append(e), done3.set())),
+            [dst3],
+            [(mkey2, 0, 4096)],
+        )
+        assert done3.wait(5), errs
+        assert not errs, errs
+        assert bytes(dst3) == bytes(anon)
+
+        # freed buffer -> unlinked file + dereg -> late READ errors out
+        buf.free()
+        failures = []
+        fired = threading.Event()
+        ch.read_in_queue(
+            FnListener(None, lambda e: (failures.append(e), fired.set())),
+            [memoryview(bytearray(16))],
+            [(buf.mkey, 0, 16)],
+        )
+        assert fired.wait(5), "read of freed region neither failed nor completed"
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_mapped_file_served_via_file_fast_path(tmp_path):
+    """A registered mapped shuffle file advertises its real path; a
+    same-host native peer preads it from page cache."""
+    from sparkrdma_tpu.memory.mapped_file import MappedFile
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "mf-srv")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "mf-cli")
+    try:
+        import numpy as np
+
+        data = np.random.default_rng(11).integers(
+            0, 256, size=200_000, dtype=np.uint8
+        ).tobytes()
+        path = tmp_path / "shuffle.data"
+        path.write_bytes(data)
+        mf = MappedFile(str(path), a.pd, block_size=65536, partition_lengths=[120_000, 80_000])
+
+        ch = b.get_channel("127.0.0.1", a.port)
+        loc = mf.get_partition_location(1)
+        dst = memoryview(bytearray(loc.length))
+        done = threading.Event()
+        errs = []
+        ch.read_in_queue(
+            FnListener(lambda _: done.set(), lambda e: (errs.append(e), done.set())),
+            [dst],
+            [(loc.mkey, loc.address, loc.length)],
+        )
+        assert done.wait(5), errs
+        assert not errs, errs
+        assert bytes(dst) == data[120_000:200_000]
+        mf.dispose()
+    finally:
+        b.stop()
+        a.stop()
