@@ -1336,8 +1336,12 @@ uint64_t srt_region_count(void* np) {
 // connect + send the HELLO preamble; blocking in the caller's thread
 // (the connect retry/timeout policy lives in the host language, like
 // RdmaNode.getRdmaChannel's retry loop)
+// kind: 0 = RPC, 1 = DATA (rides the high byte of the hello port word,
+// mirroring wire.py pack_hello — reference channel roles,
+// RdmaChannel.java:110-154)
 uint64_t srt_connect(void* np, const char* host, uint16_t port,
-                     uint16_t my_port, const char* my_id, int timeout_ms) {
+                     uint16_t my_port, const char* my_id, int timeout_ms,
+                     int kind) {
   Node* n = (Node*)np;
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   sockaddr_in addr{};
@@ -1357,7 +1361,7 @@ uint64_t srt_connect(void* np, const char* host, uint16_t port,
   size_t idlen = strlen(my_id);
   std::vector<uint8_t> hello(1 + 4 + 2 + idlen);
   hello[0] = OP_HELLO;
-  store_be32(&hello[1], my_port);
+  store_be32(&hello[1], ((uint32_t)(kind & 0xff) << 24) | my_port);
   hello[5] = idlen >> 8;
   hello[6] = idlen & 0xff;
   memcpy(&hello[7], my_id, idlen);

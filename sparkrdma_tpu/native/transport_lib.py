@@ -89,7 +89,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.srt_connect.restype = ctypes.c_uint64
         lib.srt_connect.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,
-            ctypes.c_uint16, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_uint16, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ]
         lib.srt_post_send.restype = ctypes.c_int
         lib.srt_post_send.argtypes = [

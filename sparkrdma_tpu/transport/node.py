@@ -116,7 +116,7 @@ class TpuNode:
                 if op != wire.OP_HELLO:
                     sock.close()
                     continue
-                peer_port, peer_id = wire.unpack_hello(sock)
+                peer_port, peer_id, kind = wire.unpack_hello(sock)
             except OSError:
                 sock.close()
                 continue
@@ -137,8 +137,11 @@ class TpuNode:
                     stale = channel
                     channel = None
                 else:
-                    stale = self._passive.get(peer_id)
-                    self._passive[peer_id] = channel
+                    # passive channels are per (peer, kind): an RPC and a
+                    # DATA connection from the same peer coexist
+                    # (reference channel roles, RdmaChannel.java:110-154)
+                    stale = self._passive.get((peer_id, kind))
+                    self._passive[(peer_id, kind)] = channel
             if stale is not None and stale.is_connected:
                 # stale-channel replacement (reference :134-148)
                 logger.info("replacing stale passive channel for %s", peer_id)
@@ -148,9 +151,9 @@ class TpuNode:
         lost: Optional[str] = None
         with self._lock:
             stopped = self._stopped
-            for peer_id, ch in list(self._passive.items()):
+            for (peer_id, kind), ch in list(self._passive.items()):
                 if ch is channel:
-                    del self._passive[peer_id]
+                    del self._passive[(peer_id, kind)]
                     lost = peer_id
                     break
         if lost is not None and not stopped and self._peer_lost_listener is not None:
@@ -160,14 +163,23 @@ class TpuNode:
             self._peer_lost_listener(lost)
 
     # ------------------------------------------------------------------
-    def get_channel(self, host: str, port: int, must_retry: bool = True) -> TpuChannel:
-        """Get or create the active channel to (host, port).
+    def get_channel(
+        self,
+        host: str,
+        port: int,
+        must_retry: bool = True,
+        purpose: str = "rpc",
+    ) -> TpuChannel:
+        """Get or create the active channel to (host, port, purpose).
 
         Reference getRdmaChannel(addr, mustRetry), RdmaNode.java:281-353:
         cached per remote address; connect with attempts × timeout;
-        dead cached channels are replaced.
+        dead cached channels are replaced. ``purpose`` ("rpc" | "data")
+        selects the channel flavor (RdmaChannel.java:110-154): control
+        messages and bulk READ payloads ride separate connections so an
+        8 MiB in-flight READ never head-of-line blocks a location fetch.
         """
-        key = (host, port)
+        key = (host, port, purpose)
         with self._lock:
             ch = self._active.get(key)
             if ch is not None and ch.is_connected:
@@ -188,7 +200,7 @@ class TpuNode:
             ch = None
             for attempt in range(attempts):
                 try:
-                    ch = self._connect(host, port)
+                    ch = self._connect(host, port, purpose)
                     break
                 except OSError as e:
                     last_err = e
@@ -201,13 +213,14 @@ class TpuNode:
                 self._active[key] = ch
             return ch
 
-    def _connect(self, host: str, port: int) -> TpuChannel:
+    def _connect(self, host: str, port: int, purpose: str = "rpc") -> TpuChannel:
         start = time.monotonic()
         sock = socket.create_connection(
             (host, port), timeout=self.conf.connect_timeout_ms / 1000.0
         )
         sock.settimeout(None)
-        sock.sendall(wire.pack_hello(self.port, self.executor_id))
+        kind = wire.KIND_DATA if purpose == "data" else wire.KIND_RPC
+        sock.sendall(wire.pack_hello(self.port, self.executor_id, kind))
         ch = TpuChannel(
             self.conf,
             self.pd,

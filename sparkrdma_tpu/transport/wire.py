@@ -91,12 +91,21 @@ def pack_read_err(req_id: int, msg: str) -> bytes:
     return bytes([OP_READ_ERR]) + _U64.pack(req_id) + _U32.pack(len(b)) + b
 
 
-def pack_hello(port: int, executor_id: str) -> bytes:
+# channel kinds carried in the HELLO preamble (reference channel roles,
+# RdmaChannel.java:110-154: RPC vs DATA flavors per peer). The kind
+# rides in the otherwise-unused high byte of the 4-byte port field, so
+# legacy encoders (which store 0 there) parse as KIND_RPC.
+KIND_RPC = 0
+KIND_DATA = 1
+
+
+def pack_hello(port: int, executor_id: str, kind: int = KIND_RPC) -> bytes:
     b = executor_id.encode("utf-8")
-    return bytes([OP_HELLO]) + _U32.pack(port) + struct.pack(">H", len(b)) + b
+    word = (kind << 24) | (port & 0xFFFF)
+    return bytes([OP_HELLO]) + _U32.pack(word) + struct.pack(">H", len(b)) + b
 
 
-def unpack_hello(sock: socket.socket) -> Tuple[int, str]:
-    port = _U32.unpack(read_exact(sock, 4))[0]
+def unpack_hello(sock: socket.socket) -> Tuple[int, str, int]:
+    word = _U32.unpack(read_exact(sock, 4))[0]
     (n,) = struct.unpack(">H", read_exact(sock, 2))
-    return port, read_exact(sock, n).decode("utf-8")
+    return word & 0xFFFF, read_exact(sock, n).decode("utf-8"), (word >> 24) & 0xFF
