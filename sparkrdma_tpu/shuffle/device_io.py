@@ -151,7 +151,7 @@ class DeviceShuffleIO:
                 if completed:
                     mgr.buffer_manager.put(reg)
 
-            ch = mgr.get_channel_to(loc.manager_id)
+            ch = mgr.get_channel_to(loc.manager_id, purpose="data")
             ch.read_in_queue(
                 FnListener(lambda _: on_done(), on_done),
                 [reg.view[: loc.block.length]],

@@ -210,7 +210,10 @@ class TpuShuffleFetcherIterator:
         mid, group = fetch.manager_id, fetch.group
         t0 = time.monotonic()
         try:
-            channel = self._manager.get_channel_to(mid)
+            # bulk READ payloads ride the data-flavor channel so an 8 MiB
+            # in-flight group never head-of-line blocks a location fetch
+            # on the rpc channel (RdmaChannel.java:110-154)
+            channel = self._manager.get_channel_to(mid, purpose="data")
             reg = RegisteredBuffer(self._manager.buffer_manager, group.total_length)
             # each slice holds one refcount; buffer returns to the pool
             # when the last stream closes (:399-429)

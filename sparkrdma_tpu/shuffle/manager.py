@@ -379,9 +379,9 @@ class TpuShuffleManager:
             self._maps_by_exec.pop(shuffle_id, None)
 
     # ------------------------------------------------------------------
-    def get_channel_to(self, mid: ShuffleManagerId):
+    def get_channel_to(self, mid: ShuffleManagerId, purpose: str = "rpc"):
         assert self.node is not None
-        return self.node.get_channel(mid.host, mid.port)
+        return self.node.get_channel(mid.host, mid.port, purpose=purpose)
 
     @property
     def buffer_manager(self):

@@ -28,6 +28,7 @@ import numpy as np
 
 from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
 from sparkrdma_tpu.native import transport_lib as tl
+from sparkrdma_tpu.transport import wire
 from sparkrdma_tpu.transport.channel import ChannelError
 from sparkrdma_tpu.transport.completion import CompletionListener
 from sparkrdma_tpu.utils.config import TpuShuffleConf
@@ -249,10 +250,13 @@ class NativeTpuNode:
         )
 
         self._channels: Dict[int, NativeTpuChannel] = {}  # id -> handle
-        self._active: Dict[Tuple[str, int], NativeTpuChannel] = {}
-        self._passive: Dict[str, NativeTpuChannel] = {}  # peer executor_id
+        self._active: Dict[Tuple[str, int, str], NativeTpuChannel] = {}
+        # passive channels per (peer executor_id, kind): an RPC and a
+        # DATA connection from the same peer coexist (reference channel
+        # roles, RdmaChannel.java:110-154)
+        self._passive: Dict[Tuple[str, int], NativeTpuChannel] = {}
         self._peer_of_channel: Dict[int, str] = {}
-        self._connect_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._connect_locks: Dict[Tuple[str, int, str], threading.Lock] = {}
         self._lock = threading.Lock()
 
         # outstanding work requests: wr_id -> (listener, keepalive)
@@ -410,11 +414,13 @@ class NativeTpuNode:
                 if c.payload
                 else ""
             )
-            ch = NativeTpuChannel(self, c.channel, f"{peer_id}:{c.aux}")
+            # aux is the raw 32-bit hello word (wire.pack_hello layout)
+            peer_port, chan_kind = wire.split_hello_word(c.aux)
+            ch = NativeTpuChannel(self, c.channel, f"{peer_id}:{peer_port}")
             with self._lock:
                 self._channels[c.channel] = ch
-                stale = self._passive.get(peer_id)
-                self._passive[peer_id] = ch
+                stale = self._passive.get((peer_id, chan_kind))
+                self._passive[(peer_id, chan_kind)] = ch
                 self._peer_of_channel[c.channel] = peer_id
             if stale is not None and stale.is_connected:
                 logger.info("replacing stale passive channel for %s", peer_id)
@@ -455,9 +461,20 @@ class NativeTpuNode:
             with self._lock:
                 ch = self._channels.pop(c.channel, None)
                 peer = self._peer_of_channel.pop(c.channel, None)
-                if peer is not None and self._passive.get(peer) is ch:
-                    del self._passive[peer]
-                    lost_peer = peer
+                if peer is not None:
+                    was_tracked = False
+                    for key, p in list(self._passive.items()):
+                        if p is ch:
+                            del self._passive[key]
+                            was_tracked = True
+                    # peer loss is per-peer, not per-channel-flavor: only
+                    # signal once the peer has no surviving passive
+                    # channel of any kind (reference treats CM DISCONNECT
+                    # as peer-scoped, RdmaNode.java:186-195). A stale
+                    # channel already replaced out of _passive must not
+                    # re-signal a loss the replacement already implied.
+                    if was_tracked and not any(k[0] == peer for k in self._passive):
+                        lost_peer = peer
                 for key, a in list(self._active.items()):
                     if a is ch:
                         del self._active[key]
@@ -474,8 +491,20 @@ class NativeTpuNode:
     # ------------------------------------------------------------------
     # channel cache (TpuNode.get_channel parity)
     # ------------------------------------------------------------------
-    def get_channel(self, host: str, port: int, must_retry: bool = True) -> NativeTpuChannel:
-        key = (host, port)
+    def get_channel(
+        self,
+        host: str,
+        port: int,
+        must_retry: bool = True,
+        purpose: str = "rpc",
+    ) -> NativeTpuChannel:
+        """Cached active channel per (host, port, purpose) — same
+        contract as TpuNode.get_channel (node.py): ``purpose``
+        ("rpc" | "data") selects the channel flavor so bulk READ
+        payloads never head-of-line block control messages
+        (RdmaChannel.java:110-154)."""
+        key = (host, port, purpose)
+        kind = wire.kind_of(purpose)
         with self._lock:
             ch = self._active.get(key)
             if ch is not None and ch.is_connected:
@@ -492,6 +521,7 @@ class NativeTpuNode:
                 cid = self._lib.srt_connect(
                     self._np, host.encode(), port, self.port,
                     self.executor_id.encode(), self.conf.connect_timeout_ms,
+                    kind,
                 )
                 if cid:
                     break

@@ -68,10 +68,12 @@ class TpuNode:
         from sparkrdma_tpu.utils.affinity import CpuVectorAllocator
 
         self._cpu_vectors = CpuVectorAllocator(conf.cpu_list)
-        self._active: Dict[Tuple[str, int], TpuChannel] = {}
-        self._passive: Dict[str, TpuChannel] = {}  # keyed by peer executor_id
+        self._active: Dict[Tuple[str, int, str], TpuChannel] = {}
+        # passive channels per (peer executor_id, kind): an RPC and a
+        # DATA connection from the same peer coexist
+        self._passive: Dict[Tuple[str, int], TpuChannel] = {}
         self._lock = threading.Lock()
-        self._connect_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._connect_locks: Dict[Tuple[str, int, str], threading.Lock] = {}
         self._stopped = False
 
         base_port = conf.executor_port if is_executor else conf.driver_port
@@ -154,7 +156,11 @@ class TpuNode:
             for (peer_id, kind), ch in list(self._passive.items()):
                 if ch is channel:
                     del self._passive[(peer_id, kind)]
-                    lost = peer_id
+                    # peer loss is per-peer, not per-channel-flavor: a
+                    # dying data channel while the rpc channel is healthy
+                    # (or vice versa) must not prune the peer's locations
+                    if not any(k[0] == peer_id for k in self._passive):
+                        lost = peer_id
                     break
         if lost is not None and not stopped and self._peer_lost_listener is not None:
             # peer-loss detection hook: the reference learns this from CM
@@ -219,8 +225,9 @@ class TpuNode:
             (host, port), timeout=self.conf.connect_timeout_ms / 1000.0
         )
         sock.settimeout(None)
-        kind = wire.KIND_DATA if purpose == "data" else wire.KIND_RPC
-        sock.sendall(wire.pack_hello(self.port, self.executor_id, kind))
+        sock.sendall(
+            wire.pack_hello(self.port, self.executor_id, wire.kind_of(purpose))
+        )
         ch = TpuChannel(
             self.conf,
             self.pd,

@@ -98,6 +98,17 @@ def pack_read_err(req_id: int, msg: str) -> bytes:
 KIND_RPC = 0
 KIND_DATA = 1
 
+_KIND_OF_PURPOSE = {"rpc": KIND_RPC, "data": KIND_DATA}
+
+
+def kind_of(purpose: str) -> int:
+    """Wire kind for a channel purpose; raises on unknown values so a
+    typo'd purpose can't silently create an RPC-tagged data channel."""
+    try:
+        return _KIND_OF_PURPOSE[purpose]
+    except KeyError:
+        raise ValueError(f"unknown channel purpose {purpose!r} (rpc|data)")
+
 
 def pack_hello(port: int, executor_id: str, kind: int = KIND_RPC) -> bytes:
     b = executor_id.encode("utf-8")
@@ -105,7 +116,14 @@ def pack_hello(port: int, executor_id: str, kind: int = KIND_RPC) -> bytes:
     return bytes([OP_HELLO]) + _U32.pack(word) + struct.pack(">H", len(b)) + b
 
 
+def split_hello_word(word: int) -> Tuple[int, int]:
+    """(port, kind) from the 4-byte hello word — the single definition
+    of its bit layout, shared with the native plane's ACCEPT aux."""
+    return word & 0xFFFF, (word >> 24) & 0xFF
+
+
 def unpack_hello(sock: socket.socket) -> Tuple[int, str, int]:
     word = _U32.unpack(read_exact(sock, 4))[0]
     (n,) = struct.unpack(">H", read_exact(sock, 2))
-    return word & 0xFFFF, read_exact(sock, n).decode("utf-8"), (word >> 24) & 0xFF
+    port, kind = split_hello_word(word)
+    return port, read_exact(sock, n).decode("utf-8"), kind

@@ -173,3 +173,94 @@ def test_connect_refused_raises_after_attempts():
             a.get_channel("127.0.0.1", 1)  # nothing listens on port 1
     finally:
         a.stop()
+
+
+def test_rpc_data_channel_split_python_plane():
+    """Purpose-keyed channel caching + rpc round trip while the data
+    channel is continuously saturated (python-plane twin of the native
+    HOL test; reference channel roles RdmaChannel.java:110-154)."""
+    rpc_reply = threading.Event()
+
+    def server_recv(ch, payload):
+        ch.send_in_queue(None, [b"locs:" + payload])
+
+    def client_recv(ch, payload):
+        rpc_reply.set()
+
+    a = _mk_node("hol-srv", recv=server_recv)
+    b = _mk_node("hol-cli", recv=client_recv)
+    try:
+        ch_data = b.get_channel("127.0.0.1", a.port, purpose="data")
+        ch_rpc = b.get_channel("127.0.0.1", a.port, purpose="rpc")
+        assert ch_data is not ch_rpc
+        assert b.get_channel("127.0.0.1", a.port, purpose="data") is ch_data
+        # peer sees two passive channels for "hol-cli": one per kind
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with a._lock:
+                kinds = sorted(k for p, k in a._passive if p == "hol-cli")
+            if len(kinds) == 2:
+                break
+            time.sleep(0.01)
+        assert kinds == [0, 1]
+
+        src = TpuBuffer(a.pd, 4 << 20)
+        src.write(bytes(range(256)) * (4 << 12))
+        read_errs = []
+        state = {"posted": 0, "done": 0, "stop": False}
+        lock = threading.Lock()
+        drained = threading.Event()
+        dst = memoryview(bytearray(4 << 20))
+
+        def submit():
+            ch_data.read_in_queue(
+                FnListener(lambda _: on_read(),
+                           lambda e: (read_errs.append(e), drained.set())),
+                [dst],
+                [(src.mkey, 0, 4 << 20)],
+            )
+
+        def on_read():
+            with lock:
+                state["done"] += 1
+                # repost decision and posted-count increment must be one
+                # atomic step, or drained can fire with a READ in flight
+                repost = not (state["stop"] or rpc_reply.is_set())
+                if repost:
+                    state["posted"] += 1
+                elif state["done"] == state["posted"]:
+                    drained.set()
+            if repost:
+                submit()
+
+        with lock:
+            state["posted"] += 1
+        submit()
+        ch_rpc.send_in_queue(None, [b"fetch-partition-locations"])
+        assert rpc_reply.wait(10.0), "rpc starved behind in-flight data READs"
+        with lock:
+            state["stop"] = True
+            if state["done"] == state["posted"]:
+                drained.set()
+        assert drained.wait(30), read_errs
+        assert not read_errs, read_errs
+        src.free()
+
+        # losing the data channel must NOT signal peer loss while the
+        # rpc channel survives (peer loss is per-peer, not per-flavor)
+        lost = []
+        a._peer_lost_listener = lost.append
+        ch_data.stop()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with a._lock:
+                left = [k for p, k in a._passive if p == "hol-cli"]
+            if len(left) == 1:
+                break
+            time.sleep(0.01)
+        assert left == [0]  # rpc flavor survives
+        time.sleep(0.2)
+        assert lost == []
+    finally:
+        a.stop()
+        b.stop()
