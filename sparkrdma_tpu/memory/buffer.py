@@ -133,12 +133,16 @@ class TpuBuffer:
                 _SHM_DIR, f"srt-{os.getpid()}-{secrets.token_hex(16)}"
             )
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            shm_stat = None
             try:
                 # posix_fallocate actually reserves tmpfs pages (ENOSPC
                 # now) where a sparse ftruncate would SIGBUS on first
                 # write past a small container /dev/shm
                 os.posix_fallocate(fd, 0, length)
                 self._mmap = mmap.mmap(fd, length, mmap.MAP_SHARED)
+                # identity of the SAME inode the mapping covers, for the
+                # native fast path's registration (never a path re-stat)
+                shm_stat = os.fstat(fd)
                 os.close(fd)
             except OSError:
                 os.close(fd)
@@ -158,8 +162,13 @@ class TpuBuffer:
         self._pd = pd
         self.mkey = 0
         if register:
+            # slabs are rewritten in place across pooled reuses; their
+            # shm file pages ARE this memory, so the backing is declared
+            # mutable (identity = dev/ino; content can't diverge)
             self.mkey = pd.register(
-                view, file_path=self._shm_path, file_offset=0
+                view, file_path=self._shm_path, file_offset=0,
+                file_mutable=True,
+                file_stat=shm_stat if self._shm_path else None,
             )
         self._freed = False
 

@@ -118,10 +118,13 @@ class MappedFile:
             )
             view = memoryview(mm)
             # advertise the backing file so same-host peers can pread
-            # the chunk from page cache instead of streaming it
+            # the chunk from page cache instead of streaming it; the
+            # identity comes from fstat of the mapping's own fd so a
+            # concurrent same-path rewrite can't be mistaken for it
             mkey = self._pd.register(
                 view, file_path=os.path.abspath(self.path),
                 file_offset=aligned_start,
+                file_stat=os.fstat(self._fd),
             )
             mapping_index = len(self._mappings)
             self._mappings.append(_FileMapping(mm, view, mkey, aligned_start, map_len))

@@ -47,15 +47,17 @@ class ProtectionDomain:
         view: memoryview,
         file_path: Optional[str] = None,
         file_offset: int = 0,
+        file_mutable: bool = False,
+        file_stat=None,
     ) -> int:
         """Register a memory region (read-only is fine); returns its mkey.
 
-        ``file_path``/``file_offset`` describe a file whose bytes mirror
-        the region (shm slab, mapped shuffle file). The pure-Python
-        plane streams all READs and ignores them; the native plane uses
-        them for the same-host pread fast path (transport.cpp
-        srt_reg_file)."""
-        del file_path, file_offset  # python plane always streams
+        ``file_path``/``file_offset``/``file_mutable``/``file_stat``
+        describe a file whose bytes mirror the region (shm slab, mapped
+        shuffle file). The pure-Python plane streams all READs and
+        ignores them; the native plane uses them for the same-host
+        pread fast path (transport.cpp srt_reg_file)."""
+        del file_path, file_offset, file_mutable, file_stat  # python plane streams
         with self._lock:
             mkey = self._next_mkey
             self._next_mkey += 1

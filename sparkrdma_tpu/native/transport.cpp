@@ -78,7 +78,18 @@ constexpr uint8_t OP_GOODBYE = 6;
 // streams); there is no cross-version negotiation with older binaries.
 //   READ_REQ2 = op(1) req_id(8) n(4) then n x [mkey(4) addr(8) len(4)]
 //   READ_FILE = op(1) req_id(8) body_len(4) body
-//     body    = proof_len(2) proof_path n(4) then n x [file_off(8) plen(2) path]
+//     body    = proof_len(2) proof_path n(4)
+//               then n x [file_off(8) dev(8) ino(8) size(8) mtime_ns(8)
+//                         plen(2) path]
+// dev/ino/size/mtime_ns are the backing file's identity captured at
+// REGISTRATION: the client checks them against fstat of the fd it
+// opens, so a shuffle file unlinked and rewritten at the same path (a
+// task re-attempt) between the READ_FILE answer and the pread can
+// never serve the new file's bytes — identity mismatch falls back to
+// streaming. dev+ino alone is NOT enough: ext4/tmpfs recycle inode
+// numbers immediately, so a same-size rewrite can land on the same
+// (dev, ino); the ns-resolution mtime (stable because shuffle files
+// are immutable once committed and registered) breaks the tie.
 constexpr uint8_t OP_READ_REQ2 = 9;
 constexpr uint8_t OP_READ_FILE = 10;
 
@@ -201,6 +212,35 @@ struct Command {
   std::vector<std::array<uint64_t, 3>> blocks;
 };
 
+// one advertised backing file: path + offset + the registration-time
+// identity the client must see when it opens the path
+struct FileRef {
+  std::string path;
+  uint64_t off = 0;
+  uint64_t dev = 0;
+  uint64_t ino = 0;
+  uint64_t size = 0;
+  uint64_t mtime_ns = 0;
+};
+
+inline uint64_t stat_mtime_ns(const struct stat& st) {
+  return (uint64_t)st.st_mtim.tv_sec * 1000000000ull +
+         (uint64_t)st.st_mtim.tv_nsec;
+}
+
+inline bool stat_matches(const struct stat& st, uint64_t dev, uint64_t ino,
+                         uint64_t size, uint64_t mtime_ns) {
+  if ((uint64_t)st.st_dev != dev || (uint64_t)st.st_ino != ino) return false;
+  // size==0 && mtime_ns==0 marks a MUTABLE backing (an shm slab whose
+  // pages ARE the region memory: pread always returns current region
+  // content, and its unguessable O_EXCL name makes a same-path rewrite
+  // impossible) — dev/ino identity is sufficient there. Immutable
+  // backings (committed shuffle files) carry the full identity because
+  // ext4/tmpfs recycle inode numbers immediately on unlink+create.
+  if (size == 0 && mtime_ns == 0) return true;
+  return (uint64_t)st.st_size == size && stat_mtime_ns(st) == mtime_ns;
+}
+
 // one same-host pread job, executed on the file worker thread so a
 // cold-cache disk read can never head-of-line block the epoll loop
 struct FileTask {
@@ -208,7 +248,7 @@ struct FileTask {
   uint64_t req_id = 0;
   uint8_t* dst = nullptr;
   std::vector<uint64_t> lens;
-  std::vector<std::pair<std::string, uint64_t>> files;  // path, file_off
+  std::vector<FileRef> files;
 };
 
 struct Node {
@@ -242,11 +282,22 @@ struct Node {
     std::string path;
     uint64_t file_off = 0;
     bool file_backed = false;
+    // backing-file identity at registration time (READ_FILE wire doc)
+    uint64_t file_dev = 0;
+    uint64_t file_ino = 0;
+    uint64_t file_size = 0;
+    uint64_t file_mtime_ns = 0;
   };
   std::mutex reg_mu;
   std::condition_variable reg_cv;
   std::unordered_map<uint32_t, Region> regions;
   uint32_t next_mkey = 1;
+
+  // client-side read-path accounting: how many READs completed via the
+  // same-host pread fast path vs the streamed socket path (observable
+  // from Python for tests and the bench harness)
+  std::atomic<uint64_t> stat_file_reads{0};
+  std::atomic<uint64_t> stat_streamed_reads{0};
 
   std::mutex cq_mu;
   std::condition_variable cq_cv;
@@ -497,7 +548,7 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
 // streaming serve_read otherwise.
 void serve_read2(Node* n, Conn* c, uint64_t req_id,
                  const std::vector<std::array<uint64_t, 3>>& blocks) {
-  std::vector<std::pair<std::string, uint64_t>> files;
+  std::vector<FileRef> files;
   if (!n->host_proof.empty()) {
     std::lock_guard<std::mutex> g(n->reg_mu);
     for (auto& b : blocks) {
@@ -508,7 +559,9 @@ void serve_read2(Node* n, Conn* c, uint64_t req_id,
         files.clear();
         break;
       }
-      files.emplace_back(it->second.path, it->second.file_off + b[1]);
+      files.push_back({it->second.path, it->second.file_off + b[1],
+                       it->second.file_dev, it->second.file_ino,
+                       it->second.file_size, it->second.file_mtime_ns});
     }
   }
   if (files.empty() || blocks.empty()) {
@@ -516,7 +569,7 @@ void serve_read2(Node* n, Conn* c, uint64_t req_id,
     return;
   }
   size_t body_len = 2 + n->host_proof.size() + 4;
-  for (auto& f : files) body_len += 8 + 2 + f.first.size();
+  for (auto& f : files) body_len += 8 * 5 + 2 + f.path.size();
   if (body_len > (2u << 20)) {
     // the client hard-fails READ_FILE bodies over 4 MiB as malformed;
     // an enormous block count is better served by streaming anyway
@@ -535,11 +588,15 @@ void serve_read2(Node* n, Conn* c, uint64_t req_id,
   store_be32(&out[off], (uint32_t)files.size());
   off += 4;
   for (auto& f : files) {
-    store_be64(&out[off], f.second);
-    out[off + 8] = (uint8_t)(f.first.size() >> 8);
-    out[off + 9] = (uint8_t)(f.first.size() & 0xff);
-    memcpy(&out[off + 10], f.first.data(), f.first.size());
-    off += 10 + f.first.size();
+    store_be64(&out[off], f.off);
+    store_be64(&out[off + 8], f.dev);
+    store_be64(&out[off + 16], f.ino);
+    store_be64(&out[off + 24], f.size);
+    store_be64(&out[off + 32], f.mtime_ns);
+    out[off + 40] = (uint8_t)(f.path.size() >> 8);
+    out[off + 41] = (uint8_t)(f.path.size() & 0xff);
+    memcpy(&out[off + 42], f.path.data(), f.path.size());
+    off += 42 + f.path.size();
   }
   queue_out(n, c, std::move(out), 0, false);
   if (!c->down) flush_out(n, c);
@@ -564,22 +621,22 @@ void send_read_frame(Node* n, Conn* c, uint64_t req_id,
   if (!c->down) flush_out(n, c);
 }
 
-// same-host pread execution, on the file worker thread. The fd cache
-// is worker-private; cached fds are revalidated against the current
-// inode so a recreated shuffle file at the same path is never read
-// through a stale fd (an unlinked file's fd would serve old bytes).
+// same-host pread execution, on the file worker thread. Every fd —
+// cached or freshly opened — is validated against the (dev, ino) the
+// server captured at REGISTRATION, so neither a stale cached fd nor a
+// shuffle file unlinked and rewritten at the same path (a task
+// re-attempt) can serve wrong bytes; mismatch falls back to streaming.
 bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
   uint64_t dst_off = 0;
   for (size_t i = 0; i < t.files.size(); i++) {
     uint64_t len = t.lens[i];
-    struct stat st;
-    if (stat(t.files[i].first.c_str(), &st) != 0) return false;
+    const FileRef& f = t.files[i];
     int fd = -1;
-    auto it = fd_cache.find(t.files[i].first);
+    auto it = fd_cache.find(f.path);
     if (it != fd_cache.end()) {
       struct stat fst;
-      if (fstat(it->second, &fst) == 0 && fst.st_dev == st.st_dev &&
-          fst.st_ino == st.st_ino) {
+      if (fstat(it->second, &fst) == 0 &&
+          stat_matches(fst, f.dev, f.ino, f.size, f.mtime_ns)) {
         fd = it->second;
       } else {
         close(it->second);  // unlinked/recreated: drop the stale fd
@@ -587,20 +644,27 @@ bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
       }
     }
     if (fd < 0) {
-      fd = open(t.files[i].first.c_str(), O_RDONLY);
+      fd = open(f.path.c_str(), O_RDONLY);
       if (fd < 0) return false;
+      struct stat fst;
+      if (fstat(fd, &fst) != 0 ||
+          !stat_matches(fst, f.dev, f.ino, f.size, f.mtime_ns)) {
+        // the path now names a DIFFERENT file than the one registered
+        close(fd);
+        return false;
+      }
       if (fd_cache.size() >= 64) {
         // bound the cache: never pin unlinked tmpfs inodes (and fds)
         // for the process lifetime
         for (auto& kv : fd_cache) close(kv.second);
         fd_cache.clear();
       }
-      fd_cache[t.files[i].first] = fd;
+      fd_cache[f.path] = fd;
     }
     uint64_t got = 0;
     while (got < len) {
       ssize_t r = pread(fd, t.dst + dst_off + got, (size_t)(len - got),
-                        (off_t)(t.files[i].second + got));
+                        (off_t)(f.off + got));
       if (r <= 0) return false;
       got += (uint64_t)r;
     }
@@ -715,6 +779,7 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
             c->cur_read = &it->second;
             c->st = total ? RxState::READR_BODY : RxState::OP;
             if (!total) {
+              n->stat_streamed_reads++;
               Completion comp{};
               comp.kind = COMP_READ_DONE;
               comp.status = ST_OK;
@@ -789,6 +854,7 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         pr->received += take;
         used += take;
         if (pr->received == pr->expected) {
+          n->stat_streamed_reads++;
           Completion comp{};
           comp.kind = COMP_READ_DONE;
           comp.status = ST_OK;
@@ -842,8 +908,9 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
     case RxState::READF_BODY: {
       auto it = c->reads.find(c->cur_req);
       if (it == c->reads.end()) break;  // late/unknown: nothing to do
-      // parse proof_len(2) proof_path then n x [file_off(8) plen(2) path]
-      std::vector<std::pair<std::string, uint64_t>> files;
+      // parse proof_len(2) proof_path then
+      // n x [file_off(8) dev(8) ino(8) size(8) mtime_ns(8) plen(2) path]
+      std::vector<FileRef> files;
       bool parsed = len >= 2;
       bool same_host = false;
       size_t off = 0;
@@ -868,13 +935,17 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         if (nf == it->second.blocks.size()) {
           parsed = true;
           for (uint32_t i = 0; parsed && i < nf; i++) {
-            if (off + 10 > len) { parsed = false; break; }
+            if (off + 42 > len) { parsed = false; break; }
             uint64_t foff = load_be64(data + off);
-            uint16_t plen = load_be16(data + off + 8);
-            if (off + 10 + plen > len) { parsed = false; break; }
-            files.emplace_back(
-                std::string((const char*)data + off + 10, plen), foff);
-            off += 10 + plen;
+            uint64_t fdev = load_be64(data + off + 8);
+            uint64_t fino = load_be64(data + off + 16);
+            uint64_t fsize = load_be64(data + off + 24);
+            uint64_t fmt = load_be64(data + off + 32);
+            uint16_t plen = load_be16(data + off + 40);
+            if (off + 42 + plen > len) { parsed = false; break; }
+            files.push_back({std::string((const char*)data + off + 42, plen),
+                             foff, fdev, fino, fsize, fmt});
+            off += 42 + plen;
           }
         }
       }
@@ -1068,6 +1139,7 @@ void loop_main(Node* n) {
               PendingRead pr = std::move(fit->second);
               n->file_pending.erase(fit);
               if (cmd.kind == Command::FILE_DONE) {
+                n->stat_file_reads++;
                 Completion comp{};
                 comp.kind = COMP_READ_DONE;
                 comp.status = ST_OK;
@@ -1269,9 +1341,18 @@ uint32_t srt_reg(void* np, const void* ptr, uint64_t len) {
 
 // register a region whose bytes are identical to [file_off, file_off+len)
 // of the file at `path` (an shm slab or a mapped shuffle file): same-host
-// peers may pread it directly instead of streaming through the socket
+// peers may pread it directly instead of streaming through the socket.
+// The caller supplies the backing file's identity from fstat of the SAME
+// fd that backs the mapping — never from a fresh stat(path), which would
+// race a concurrent rewrite of the path (identity would describe the new
+// file while the region memory holds the old bytes). size=0 && mtime_ns=0
+// declares a MUTABLE backing (shm slab: the file pages ARE the region) —
+// identity is then dev/ino only (see READ_FILE wire doc). dev==0 &&
+// ino==0 means "no identity": registered as a plain streamed region.
 uint32_t srt_reg_file(void* np, const void* ptr, uint64_t len,
-                      const char* path, uint64_t file_off) {
+                      const char* path, uint64_t file_off,
+                      uint64_t dev, uint64_t ino,
+                      uint64_t size, uint64_t mtime_ns) {
   Node* n = (Node*)np;
   std::lock_guard<std::mutex> g(n->reg_mu);
   uint32_t mkey = n->next_mkey++;
@@ -1280,7 +1361,13 @@ uint32_t srt_reg_file(void* np, const void* ptr, uint64_t len,
   r.len = len;
   r.path = path ? path : "";
   r.file_off = file_off;
-  r.file_backed = path && path[0];
+  r.file_backed = path && path[0] && (dev || ino);
+  if (r.file_backed) {
+    r.file_dev = dev;
+    r.file_ino = ino;
+    r.file_size = size;
+    r.file_mtime_ns = mtime_ns;
+  }
   n->regions[mkey] = r;
   return mkey;
 }
@@ -1324,6 +1411,16 @@ int srt_dereg(void* np, uint32_t mkey) {
     return -1;
   }
   return 0;
+}
+
+// client-side read-path counters (tests + bench): READs completed via
+// the same-host pread fast path vs the streamed socket path
+uint64_t srt_stat_file_reads(void* np) {
+  return ((Node*)np)->stat_file_reads.load();
+}
+
+uint64_t srt_stat_streamed_reads(void* np) {
+  return ((Node*)np)->stat_streamed_reads.load();
 }
 
 uint64_t srt_region_count(void* np) {

@@ -81,11 +81,19 @@ def load() -> Optional[ctypes.CDLL]:
         lib.srt_reg_file.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_char_p, ctypes.c_uint64,
+            # backing-file identity from the caller's fstat of the
+            # mapping fd: dev, ino, size, mtime_ns
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64,
         ]
         lib.srt_dereg.restype = ctypes.c_int
         lib.srt_dereg.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.srt_region_count.restype = ctypes.c_uint64
         lib.srt_region_count.argtypes = [ctypes.c_void_p]
+        lib.srt_stat_file_reads.restype = ctypes.c_uint64
+        lib.srt_stat_file_reads.argtypes = [ctypes.c_void_p]
+        lib.srt_stat_streamed_reads.restype = ctypes.c_uint64
+        lib.srt_stat_streamed_reads.argtypes = [ctypes.c_void_p]
         lib.srt_connect.restype = ctypes.c_uint64
         lib.srt_connect.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -61,19 +62,44 @@ class NativeProtectionDomain:
         view: memoryview,
         file_path: Optional[str] = None,
         file_offset: int = 0,
+        file_mutable: bool = False,
+        file_stat: Optional[os.stat_result] = None,
     ) -> int:
         """Register a region; when ``file_path`` names a file whose
         bytes at ``file_offset`` are identical to the region (an shm
         slab or a mapped shuffle file), same-host peers serve READs by
-        pread-ing it straight from page cache instead of streaming."""
+        pread-ing it straight from page cache instead of streaming.
+
+        ``file_stat`` should be the caller's ``os.fstat`` of the SAME
+        fd that backs the region's mapping — identity taken from a
+        fresh ``os.stat(path)`` (the fallback) can race a concurrent
+        rewrite of the path. ``file_mutable`` declares the backing's
+        content may change after registration while staying equal to
+        the region memory (shm slabs: the file pages ARE the region);
+        immutable backings (committed shuffle files) get a full
+        (dev, ino, size, mtime_ns) identity check so a task re-attempt
+        rewriting the same path can never serve wrong bytes
+        (transport.cpp READ_FILE wire doc)."""
         np_handle = self._node._np
         if not np_handle:
             raise RuntimeError("native node stopped; cannot register regions")
         if file_path:
-            mkey = tl.load().srt_reg_file(
-                np_handle, _addr_of(view), len(view),
-                file_path.encode(), file_offset,
-            )
+            if file_stat is None:
+                try:
+                    file_stat = os.stat(file_path)
+                except OSError:
+                    file_stat = None
+            if file_stat is None:
+                # unverifiable backing: plain streamed region
+                mkey = tl.load().srt_reg(np_handle, _addr_of(view), len(view))
+            else:
+                size_id = 0 if file_mutable else file_stat.st_size
+                mtime_id = 0 if file_mutable else file_stat.st_mtime_ns
+                mkey = tl.load().srt_reg_file(
+                    np_handle, _addr_of(view), len(view),
+                    file_path.encode(), file_offset,
+                    file_stat.st_dev, file_stat.st_ino, size_id, mtime_id,
+                )
         else:
             mkey = tl.load().srt_reg(np_handle, _addr_of(view), len(view))
         with self._lock:
@@ -535,6 +561,17 @@ class NativeTpuNode:
                 self._channels[cid] = ch
                 self._active[key] = ch
             return ch
+
+    def read_path_stats(self) -> Tuple[int, int]:
+        """(file_fast_path_reads, streamed_reads) completed by this
+        node's client side — observability for tests and the bench."""
+        np_handle = self._np  # capture once: stop() nulls it concurrently
+        if not np_handle:
+            return (0, 0)
+        return (
+            self._lib.srt_stat_file_reads(np_handle),
+            self._lib.srt_stat_streamed_reads(np_handle),
+        )
 
     def _close_channel(self, ch: NativeTpuChannel) -> None:
         ch._dead.set()

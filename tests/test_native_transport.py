@@ -279,6 +279,10 @@ def test_same_host_file_fast_path():
         assert done.wait(5), errs
         assert not errs, errs
         assert bytes(dst) == src[12345 : 12345 + 65536].tobytes()
+        # provably served by the pread fast path, not streamed (the
+        # mutable-slab identity must keep the fast path alive even
+        # though the slab was written AFTER registration)
+        assert b.read_path_stats() == (1, 0)
 
         # multi-block read spanning file-backed + file-backed
         dst2 = [memoryview(bytearray(1000)), memoryview(bytearray(2000))]
@@ -307,6 +311,10 @@ def test_same_host_file_fast_path():
         assert done3.wait(5), errs
         assert not errs, errs
         assert bytes(dst3) == bytes(anon)
+        # 3 fast-path completions: the single READ plus one per block of
+        # the aligned multi-block read (posted as one request per block)
+        file_reads, streamed_reads = b.read_path_stats()
+        assert file_reads == 3 and streamed_reads == 1
 
         # freed buffer -> unlinked file + dereg -> late READ errors out
         buf.free()
@@ -392,54 +400,75 @@ def test_rpc_data_channel_split_no_hol_blocking():
         # 8 MiB registered region, streamed (no file hint -> no pread
         # fast path); 4 READ slots that repost on completion so the
         # data channel never idles until the rpc reply is observed
+        from transport_harness import saturate_reads_until
+
         src = memoryview(bytearray(8 << 20))
         src[: 1 << 16] = bytes(range(256)) * 256
         mkey = a.pd.register(src)
         read_errs = []
-        state = {"posted": 0, "done": 0, "stop": False}
-        lock = threading.Lock()
         drained = threading.Event()
         dsts = [memoryview(bytearray(8 << 20)) for _ in range(4)]
-
-        def submit(dst):
-            ch_data.read_in_queue(
-                FnListener(lambda _, d=dst: on_read(d),
-                           lambda e: (read_errs.append(e), drained.set())),
-                [dst],
-                [(mkey, 0, 8 << 20)],
-            )
-
-        def on_read(dst):
-            with lock:
-                state["done"] += 1
-                # repost decision and posted-count increment must be one
-                # atomic step, or drained can fire with a READ in flight
-                repost = not (state["stop"] or rpc_reply.is_set())
-                if repost:
-                    state["posted"] += 1
-                elif state["done"] == state["posted"]:
-                    drained.set()
-            if repost:
-                submit(dst)
-
-        for dst in dsts:
-            with lock:
-                state["posted"] += 1
-            submit(dst)
+        finish = saturate_reads_until(
+            ch_data, mkey, 8 << 20, dsts, rpc_reply, read_errs, drained
+        )
         # location-fetch round trip on the rpc channel while READs
         # saturate the data channel: must complete promptly, not once
         # the data stream goes idle
         ch_rpc.send_in_queue(None, [b"fetch-partition-locations"])
         assert rpc_reply.wait(10.0), "rpc starved behind in-flight data READs"
-        with lock:
-            state["stop"] = True
-            if state["done"] == state["posted"]:
-                drained.set()
-            moved = state["done"]
+        finish()
         assert drained.wait(30), read_errs
         assert not read_errs, read_errs
         assert bytes(dsts[0][: 1 << 16]) == bytes(src[: 1 << 16])
-        assert moved >= 0  # informational; saturation is structural
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_file_fast_path_rejects_recreated_file(tmp_path):
+    """A shuffle file unlinked and rewritten at the same path (task
+    re-attempt) between registration and the client's pread must NOT
+    serve the new file's bytes: the READ_FILE answer carries the
+    registration-time (st_dev, st_ino) and the client falls back to
+    streaming on mismatch, still yielding the registered bytes."""
+    import os
+
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    conf = TpuShuffleConf()
+    a = NativeTpuNode(conf, "127.0.0.1", False, "inode-srv")
+    b = NativeTpuNode(conf, "127.0.0.1", True, "inode-cli")
+    try:
+        old = bytes([i % 251 for i in range(200_000)])
+        path = tmp_path / "attempt0.data"
+        path.write_bytes(old)
+        # region memory holds the ORIGINAL bytes (mmap analogue: the
+        # registered view outlives the directory entry)
+        src = memoryview(bytearray(old))
+        mkey = a.pd.register(src, file_path=str(path), file_offset=0)
+
+        # task re-attempt rewrites the same path with different bytes
+        os.unlink(path)
+        path.write_bytes(bytes([(i * 7 + 3) % 251 for i in range(200_000)]))
+
+        ch = b.get_channel("127.0.0.1", a.port, purpose="data")
+        dst = memoryview(bytearray(200_000))
+        done = threading.Event()
+        errs = []
+        ch.read_in_queue(
+            FnListener(lambda _: done.set(), lambda e: (errs.append(e), done.set())),
+            [dst],
+            [(mkey, 0, 200_000)],
+        )
+        assert done.wait(10), "read never completed"
+        assert not errs, errs
+        assert bytes(dst) == old, (
+            "recreated file at the registered path leaked its bytes into "
+            "a READ of the original region"
+        )
+        # the identity mismatch must have forced the streamed fallback
+        file_reads, streamed_reads = b.read_path_stats()
+        assert file_reads == 0 and streamed_reads == 1
     finally:
         b.stop()
         a.stop()

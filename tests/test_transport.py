@@ -204,44 +204,19 @@ def test_rpc_data_channel_split_python_plane():
             time.sleep(0.01)
         assert kinds == [0, 1]
 
+        from transport_harness import saturate_reads_until
+
         src = TpuBuffer(a.pd, 4 << 20)
         src.write(bytes(range(256)) * (4 << 12))
         read_errs = []
-        state = {"posted": 0, "done": 0, "stop": False}
-        lock = threading.Lock()
         drained = threading.Event()
         dst = memoryview(bytearray(4 << 20))
-
-        def submit():
-            ch_data.read_in_queue(
-                FnListener(lambda _: on_read(),
-                           lambda e: (read_errs.append(e), drained.set())),
-                [dst],
-                [(src.mkey, 0, 4 << 20)],
-            )
-
-        def on_read():
-            with lock:
-                state["done"] += 1
-                # repost decision and posted-count increment must be one
-                # atomic step, or drained can fire with a READ in flight
-                repost = not (state["stop"] or rpc_reply.is_set())
-                if repost:
-                    state["posted"] += 1
-                elif state["done"] == state["posted"]:
-                    drained.set()
-            if repost:
-                submit()
-
-        with lock:
-            state["posted"] += 1
-        submit()
+        finish = saturate_reads_until(
+            ch_data, src.mkey, 4 << 20, [dst], rpc_reply, read_errs, drained
+        )
         ch_rpc.send_in_queue(None, [b"fetch-partition-locations"])
         assert rpc_reply.wait(10.0), "rpc starved behind in-flight data READs"
-        with lock:
-            state["stop"] = True
-            if state["done"] == state["posted"]:
-                drained.set()
+        finish()
         assert drained.wait(30), read_errs
         assert not read_errs, read_errs
         src.free()

@@ -1,0 +1,57 @@
+"""Shared harness for the rpc/data channel-split (head-of-line) tests.
+
+Keeps the data channel continuously saturated with in-flight READs —
+each completion reposts itself until ``stop_when`` is set — so a control
+round-trip racing it is provably concurrent with data traffic. The
+repost decision and the posted-count increment happen under one lock
+hold: deciding to repost outside the lock would let the drain handshake
+fire while a READ is still about to be posted.
+"""
+
+import threading
+
+
+def saturate_reads_until(channel, mkey, nbytes, dsts, stop_when,
+                         read_errs, drained):
+    """Start one self-reposting READ per dst. READs of
+    ``(mkey, 0, nbytes)`` repost until ``stop_when`` (an Event) is set;
+    ``drained`` fires once every posted READ has completed. Returns a
+    ``finish()`` callable: call it after ``stop_when`` is set to resolve
+    the in-flight==0 handshake, then wait on ``drained``."""
+    from sparkrdma_tpu.transport import FnListener
+
+    state = {"posted": 0, "done": 0, "stop": False}
+    lock = threading.Lock()
+
+    def submit(dst):
+        channel.read_in_queue(
+            FnListener(lambda _, d=dst: on_read(d),
+                       lambda e: (read_errs.append(e), drained.set())),
+            [dst],
+            [(mkey, 0, nbytes)],
+        )
+
+    def on_read(dst):
+        with lock:
+            state["done"] += 1
+            repost = not (state["stop"] or stop_when.is_set())
+            if repost:
+                state["posted"] += 1
+            elif state["done"] == state["posted"]:
+                drained.set()
+        if repost:
+            submit(dst)
+
+    for dst in dsts:
+        with lock:
+            state["posted"] += 1
+        submit(dst)
+
+    def finish():
+        with lock:
+            state["stop"] = True
+            if state["done"] == state["posted"]:
+                drained.set()
+            return state["done"]
+
+    return finish
