@@ -293,6 +293,24 @@ class DeviceBufferManager:
         """Pool + stage in one step (host bytes -> registered HBM slab)."""
         return self.get(len(data)).stage(data)
 
+    def stage_view(self, view) -> DeviceBuffer:
+        """Pool + stage from a buffer-protocol object WITHOUT the host
+        round trip ``stage_bytes`` pays: the device transfer reads the
+        source memory directly (one DMA) and padding to the slab's
+        size class happens on-device, so a fetch's registered buffer
+        never materializes an intermediate ``bytes`` (SURVEY.md §7.3(3):
+        the copy count at the host<->HBM seam is the difference between
+        matching and missing the wire rate)."""
+        src = np.frombuffer(view, dtype=np.uint8)
+        buf = self.get(src.nbytes)
+        arr = jax.device_put(src, buf.device)
+        buf = buf.put_array(arr)
+        # device_put may read the source asynchronously; callers recycle
+        # the source buffer (a pooled registered region) immediately, so
+        # the transfer must be complete before this returns
+        jax.block_until_ready(buf.array)
+        return buf
+
     # ------------------------------------------------------------------
     @property
     def in_use_bytes(self) -> int:

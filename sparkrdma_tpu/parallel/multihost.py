@@ -38,6 +38,16 @@ def initialize(
     (``host0:port``, world size, this process's rank)."""
     if num_processes is not None and num_processes <= 1:
         return
+    # idempotent like startRdmaNodeIfMissing: skip when the runtime is
+    # already up (jax raises on a second initialize). The state object
+    # is internal-only (jax._src), so guard the import.
+    try:
+        from jax._src.distributed import global_state as _state
+    except ImportError:
+        _state = None
+    if _state is not None and getattr(_state, "client", None) is not None:
+        logger.debug("jax.distributed already initialized; skipping")
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -45,8 +55,11 @@ def initialize(
             process_id=process_id,
         )
     except RuntimeError as e:
-        # already initialized: idempotent like startRdmaNodeIfMissing
-        if "already" not in str(e).lower():
+        # fallback idempotence when global_state isn't inspectable; the
+        # live runtime says "should only be called once", older versions
+        # said "already initialized"
+        msg = str(e).lower()
+        if "already" not in msg and "only be called once" not in msg:
             raise
         logger.debug("jax.distributed already initialized: %s", e)
 

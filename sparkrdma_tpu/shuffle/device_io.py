@@ -67,15 +67,22 @@ class DeviceShuffleIO:
         locs: List[PartitionLocation] = []
         staged = []
         for pid, arr in partitions.items():
-            data = np.asarray(arr).tobytes()  # HBM -> host
-            buf = mgr.buffer_manager.get(len(data))
-            buf.write(data)
+            # HBM -> registered memory in ONE host copy: the device
+            # readback lands in a host array and its bytes move straight
+            # into the registered shm view (no intermediate tobytes()/
+            # write() materializations — SURVEY.md §7.3(3))
+            host = np.asarray(arr)
+            nbytes = host.nbytes
+            buf = mgr.buffer_manager.get(nbytes)
+            np.frombuffer(buf.view, dtype=np.uint8, count=nbytes)[:] = (
+                host.reshape(-1).view(np.uint8)
+            )
             staged.append(buf)
             locs.append(
                 PartitionLocation(
                     mgr.local_manager_id,
                     pid,
-                    BlockLocation(0, len(data), buf.mkey),
+                    BlockLocation(0, nbytes, buf.mkey),
                 )
             )
         with self._lock:
@@ -162,11 +169,12 @@ class DeviceShuffleIO:
         try:
             for loc in locations:
                 if loc.manager_id.executor_id == my_id:
-                    # local short-circuit straight from the registered region
+                    # local short-circuit straight from the registered
+                    # region — DMA'd directly, never copied to bytes
                     view = mgr.node.pd.resolve(
                         loc.block.mkey, loc.block.address, loc.block.length
                     )
-                    dev = self._dev.stage_bytes(bytes(view))
+                    dev = self._dev.stage_view(view)
                     out.setdefault(loc.partition_id, []).append(dev)
                     continue
                 reg = mgr.buffer_manager.get(loc.block.length)
@@ -179,7 +187,11 @@ class DeviceShuffleIO:
                     raise FetchFailedError(
                         loc.manager_id, shuffle_id, -1, loc.partition_id, str(err)
                     )
-                dev = self._dev.stage_bytes(bytes(reg.view[: loc.block.length]))
+                # registered buffer -> HBM directly (one DMA, on-device
+                # padding); the buffer returns to the pool only after
+                # the transfer, which device_put completes synchronously
+                # for host sources
+                dev = self._dev.stage_view(reg.view[: loc.block.length])
                 mgr.buffer_manager.put(reg)  # pooled reuse, not a cold free
                 pending[i] = None
                 out.setdefault(loc.partition_id, []).append(dev)
