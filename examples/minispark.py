@@ -1,0 +1,289 @@
+"""MiniSpark — a FOREIGN engine proving the drop-in shuffle SPI.
+
+This file is deliberately a third-party codebase in miniature: a tiny
+PySpark-shaped engine with its own conf, its own partitioner class, its
+own builtin hash shuffle, and user-facing RDD operations. It imports
+NOTHING from sparkrdma_tpu at module level. Exactly like Spark's
+
+    spark.shuffle.manager = org.apache.spark.shuffle.rdma.RdmaShuffleManager
+
+(reference README.md:52-58, RdmaShuffleManager.scala:40-41), setting ONE
+config key
+
+    engine.shuffle.manager = sparkrdma_tpu.shuffle.TpuShuffleManager
+
+swaps the entire shuffle plane for the TPU-native framework, resolved
+dynamically by class path. User job code is byte-identical under both
+managers; the engine drives only the documented SPI surface:
+
+    manager = Manager(conf_dict, is_driver=..., executor_id=...)
+    handle  = Handle(shuffle_id, num_maps, partitioner)   # duck-typed
+    manager.register_shuffle(handle)                       # driver
+    writer  = manager.get_writer(handle, map_id); writer.write(it); writer.stop(True)
+    manager.finalize_maps(shuffle_id)                      # per executor
+    reader  = manager.get_reader(handle, lo, hi); reader.read()
+    manager.unregister_shuffle(shuffle_id); manager.stop()
+
+(the same verbs Spark's ShuffleManager trait exposes,
+RdmaShuffleManager.scala:187-332).
+"""
+
+from __future__ import annotations
+
+import importlib
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+# ----------------------------------------------------------------------
+# the foreign engine's own types (no framework imports)
+# ----------------------------------------------------------------------
+class MiniConf(dict):
+    """PySpark-style string conf."""
+
+    def set(self, key: str, value: str) -> "MiniConf":
+        self[key] = value
+        return self
+
+
+class MiniHashPartitioner:
+    """The engine's OWN partitioner — satisfies the SPI duck type
+    (``num_partitions`` attribute + ``partition(key) -> int``)."""
+
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+
+    def partition(self, key) -> int:
+        return hash(key) % self.num_partitions
+
+
+class _MiniHandle:
+    """The engine's own shuffle handle — carries what the SPI documents:
+    shuffle_id, num_maps, partitioner (duck-typed, like Spark's
+    ShuffleDependency attributes, RdmaShuffleManager.scala:223-227)."""
+
+    def __init__(self, shuffle_id: int, num_maps: int, partitioner):
+        self.shuffle_id = shuffle_id
+        self.num_maps = num_maps
+        self.partitioner = partitioner
+        # SPI-optional attributes the framework reader understands
+        self.serializer = None
+        self.aggregator = None
+        self.key_ordering = None
+        self.map_side_combine = False
+
+
+# ----------------------------------------------------------------------
+# builtin shuffle (what the engine ships with; the thing being replaced)
+# ----------------------------------------------------------------------
+class _BuiltinWriter:
+    def __init__(self, store, shuffle_id, map_id, partitioner):
+        self._store = store
+        self._sid = shuffle_id
+        self._map = map_id
+        self._part = partitioner
+
+    def write(self, records: Iterable[Tuple]) -> None:
+        buckets = defaultdict(list)
+        for k, v in records:
+            buckets[self._part.partition(k)].append((k, v))
+        self._store[(self._sid, self._map)] = dict(buckets)
+
+    def stop(self, success: bool) -> None:
+        if not success:
+            self._store.pop((self._sid, self._map), None)
+
+
+class _BuiltinReader:
+    def __init__(self, store, shuffle_id, num_maps, lo, hi):
+        self._store = store
+        self._sid = shuffle_id
+        self._num_maps = num_maps
+        self._lo, self._hi = lo, hi
+
+    def read(self):
+        for m in range(self._num_maps):
+            buckets = self._store.get((self._sid, m), {})
+            for p in range(self._lo, self._hi):
+                yield from buckets.get(p, [])
+
+
+class BuiltinShuffleManager:
+    """The engine's stock single-process hash shuffle."""
+
+    def __init__(self, conf, is_driver: bool, executor_id: str = "driver"):
+        self._store: Dict = {}
+
+    def register_shuffle(self, handle):
+        return handle
+
+    def get_writer(self, handle, map_id: int):
+        return _BuiltinWriter(
+            self._store, handle.shuffle_id, map_id, handle.partitioner
+        )
+
+    def finalize_maps(self, shuffle_id: int) -> None:
+        pass
+
+    def get_reader(self, handle, lo: int, hi: int):
+        return _BuiltinReader(
+            self._store, handle.shuffle_id, handle.num_maps, lo, hi
+        )
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self._store = {
+            k: v for k, v in self._store.items() if k[0] != shuffle_id
+        }
+
+    def stop(self) -> None:
+        self._store.clear()
+
+
+def _resolve_manager_class(class_path: str):
+    """``pkg.module.Class`` -> class, the spark.shuffle.manager lookup."""
+    mod_name, _, cls_name = class_path.rpartition(".")
+    return getattr(importlib.import_module(mod_name), cls_name)
+
+
+# ----------------------------------------------------------------------
+# the engine
+# ----------------------------------------------------------------------
+class MiniSparkContext:
+    """2-executor local engine; the shuffle plane is whatever
+    ``engine.shuffle.manager`` names."""
+
+    NUM_EXECUTORS = 2
+
+    def __init__(self, conf: Optional[MiniConf] = None):
+        self.conf = conf or MiniConf()
+        class_path = self.conf.get("engine.shuffle.manager", "builtin")
+        self._next_shuffle = 0
+        if class_path == "builtin":
+            self.driver = BuiltinShuffleManager(self.conf, is_driver=True)
+            # the builtin store is process-wide; executors share it
+            self.executors = [self.driver] * self.NUM_EXECUTORS
+        else:
+            manager_cls = _resolve_manager_class(class_path)
+            # the SPI constructor contract: (conf_mapping, is_driver,
+            # executor_id). The engine passes its OWN conf mapping;
+            # unknown engine.* keys are ignored by the manager, and the
+            # driver writes its negotiated port back into the mapping
+            # (SparkConf semantics) so executors built afterwards
+            # inherit it.
+            self.driver = manager_cls(self.conf, is_driver=True)
+            self.executors = [
+                manager_cls(
+                    self.conf, is_driver=False, executor_id=f"mini-{i}"
+                )
+                for i in range(self.NUM_EXECUTORS)
+            ]
+
+    def parallelize(self, data: List[Tuple], num_slices: int = 4) -> "MiniRDD":
+        chunk = max(1, (len(data) + num_slices - 1) // num_slices)
+        return MiniRDD(
+            self, [data[i : i + chunk] for i in range(0, len(data), chunk)]
+        )
+
+    # -- the engine's shuffle execution, SPI verbs only ------------------
+    def _run_shuffle(self, slices, partitioner) -> List[List[Tuple]]:
+        sid = self._next_shuffle
+        self._next_shuffle += 1
+        # register_shuffle returns the manager's canonical handle (the
+        # reference picks its own handle class there too); the engine
+        # must use it for every subsequent SPI call
+        handle = self.driver.register_shuffle(
+            _MiniHandle(sid, num_maps=len(slices), partitioner=partitioner)
+        )
+        try:
+            for map_id, part in enumerate(slices):
+                ex = self.executors[map_id % len(self.executors)]
+                w = ex.get_writer(handle, map_id)
+                w.write(iter(part))
+                w.stop(True)
+            for ex in self.executors:
+                ex.finalize_maps(sid)
+            n = partitioner.num_partitions
+            out: List[List[Tuple]] = []
+            for p in range(n):
+                ex = self.executors[p % len(self.executors)]
+                out.append(list(ex.get_reader(handle, p, p + 1).read()))
+            return out
+        finally:
+            self.driver.unregister_shuffle(sid)
+            for ex in self.executors:
+                if ex is not self.driver:
+                    ex.unregister_shuffle(sid)
+
+    def stop(self) -> None:
+        for ex in self.executors:
+            if ex is not self.driver:
+                ex.stop()
+        self.driver.stop()
+
+
+class MiniRDD:
+    """User-facing slice of the API: map / reduceByKey / groupByKey /
+    collect — job code never sees the shuffle manager."""
+
+    def __init__(self, ctx: MiniSparkContext, slices: List[List[Tuple]]):
+        self._ctx = ctx
+        self._slices = slices
+
+    def map(self, fn: Callable) -> "MiniRDD":
+        return MiniRDD(self._ctx, [[fn(x) for x in s] for s in self._slices])
+
+    def reduce_by_key(self, fn: Callable, num_partitions: int = 4) -> "MiniRDD":
+        parts = self._ctx._run_shuffle(
+            self._slices, MiniHashPartitioner(num_partitions)
+        )
+        out = []
+        for part in parts:
+            acc: Dict = {}
+            for k, v in part:
+                acc[k] = fn(acc[k], v) if k in acc else v
+            out.append(list(acc.items()))
+        return MiniRDD(self._ctx, out)
+
+    def group_by_key(self, num_partitions: int = 4) -> "MiniRDD":
+        parts = self._ctx._run_shuffle(
+            self._slices, MiniHashPartitioner(num_partitions)
+        )
+        out = []
+        for part in parts:
+            acc: Dict = defaultdict(list)
+            for k, v in part:
+                acc[k].append(v)
+            out.append([(k, sorted(vs)) for k, vs in acc.items()])
+        return MiniRDD(self._ctx, out)
+
+    def collect(self) -> List[Tuple]:
+        return [x for s in self._slices for x in s]
+
+
+# ----------------------------------------------------------------------
+def wordcount_job(ctx: MiniSparkContext) -> List[Tuple[str, int]]:
+    """A user job. NOTE: it references only engine API — identical under
+    the builtin and the TPU-native shuffle manager."""
+    words = (
+        ["the", "quick", "brown", "fox"] * 250
+        + ["jumps", "over", "the", "lazy", "dog"] * 200
+    )
+    rdd = ctx.parallelize([(w, 1) for w in words], num_slices=8)
+    counts = rdd.reduce_by_key(lambda a, b: a + b, num_partitions=4)
+    return sorted(counts.collect())
+
+
+if __name__ == "__main__":
+    # stock engine
+    ctx = MiniSparkContext()
+    stock = wordcount_job(ctx)
+    ctx.stop()
+    # one key flips the shuffle plane to the TPU-native framework
+    conf = MiniConf().set(
+        "engine.shuffle.manager", "sparkrdma_tpu.shuffle.TpuShuffleManager"
+    )
+    ctx = MiniSparkContext(conf)
+    swapped = wordcount_job(ctx)
+    ctx.stop()
+    assert stock == swapped, "drop-in shuffle changed job results"
+    print("drop-in OK:", swapped[:3], "...")

@@ -38,7 +38,7 @@ from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
 from sparkrdma_tpu.shuffle.stats import ShuffleReaderStats
 from sparkrdma_tpu.transport import FnListener, TpuNode, create_node
-from sparkrdma_tpu.utils.config import ShuffleWriterMethod, TpuShuffleConf
+from sparkrdma_tpu.utils.config import PREFIX, ShuffleWriterMethod, TpuShuffleConf
 
 logger = logging.getLogger(__name__)
 
@@ -51,6 +51,15 @@ class TpuShuffleManager:
         executor_id: Optional[str] = None,
         host: str = "127.0.0.1",
     ):
+        # drop-in SPI contract: a foreign engine may pass any plain
+        # mapping (its own conf object, the SparkConf role). The driver
+        # writes the negotiated listener port back INTO that mapping so
+        # executors constructed from it afterwards inherit it — exactly
+        # conf.setDriverPort semantics (RdmaShuffleManager.scala:183-184)
+        self._external_conf = None
+        if not isinstance(conf, TpuShuffleConf):
+            self._external_conf = conf
+            conf = TpuShuffleConf(dict(conf))
         self.conf = conf
         self.is_driver = is_driver
         self.executor_id = executor_id or ("driver" if is_driver else "executor")
@@ -94,6 +103,11 @@ class TpuShuffleManager:
                 peer_lost_listener=self._on_peer_lost,
             )
             conf.set_driver_port(self.node.port)
+            if self._external_conf is not None:
+                try:
+                    self._external_conf[PREFIX + "driverPort"] = str(self.node.port)
+                except TypeError:
+                    pass  # immutable mapping: executors need the port passed
 
         self.resolver = TpuShuffleBlockResolver(self)
 
@@ -335,9 +349,30 @@ class TpuShuffleManager:
     # ------------------------------------------------------------------
     # shuffle SPI (reference :187-330)
     # ------------------------------------------------------------------
-    def register_shuffle(self, handle: BaseShuffleHandle) -> BaseShuffleHandle:
-        """Driver-only: build the per-partition location registry (:187-239)."""
+    def register_shuffle(self, handle) -> BaseShuffleHandle:
+        """Driver-only: build the per-partition location registry (:187-239).
+
+        Returns the canonical handle the engine must pass to
+        ``get_writer``/``get_reader`` — a foreign engine's duck-typed
+        handle (``shuffle_id``, ``num_maps``, ``partitioner`` with
+        ``num_partitions`` + ``partition(key)``) is adapted here, the
+        same place the reference chooses its own handle class
+        (RdmaShuffleManager.scala:231-238)."""
         assert self.is_driver, "register_shuffle must run on the driver"
+        if not isinstance(handle, BaseShuffleHandle):
+            extra = {}
+            serializer = getattr(handle, "serializer", None)
+            if serializer is not None:
+                extra["serializer"] = serializer
+            handle = BaseShuffleHandle(
+                shuffle_id=handle.shuffle_id,
+                num_maps=handle.num_maps,
+                partitioner=handle.partitioner,
+                aggregator=getattr(handle, "aggregator", None),
+                map_side_combine=bool(getattr(handle, "map_side_combine", False)),
+                key_ordering=bool(getattr(handle, "key_ordering", False)),
+                **extra,
+            )
         with self._lock:
             self._registered[handle.shuffle_id] = handle
             self._partition_locations.setdefault(

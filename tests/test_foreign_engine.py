@@ -1,0 +1,90 @@
+"""Drop-in SPI proof (SURVEY.md §5.1 invariant #1): a FOREIGN engine
+(examples/minispark.py — its own conf, partitioner, handle, and builtin
+shuffle; zero framework imports at module level) swaps its entire
+shuffle plane for TpuShuffleManager by setting ONE config key, with the
+user job unchanged — the reference's defining capability
+(README.md:52-58, spark.shuffle.manager=...RdmaShuffleManager).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+from minispark import MiniConf, MiniSparkContext, wordcount_job  # noqa: E402
+
+SWAP_KEY = "engine.shuffle.manager"
+SWAP_VALUE = "sparkrdma_tpu.shuffle.TpuShuffleManager"
+
+
+def _run(conf=None):
+    ctx = MiniSparkContext(conf)
+    try:
+        return wordcount_job(ctx), ctx
+    finally:
+        ctx.stop()
+
+
+def test_one_key_swaps_shuffle_plane_same_results():
+    stock, stock_ctx = _run()
+    swapped, ctx = _run(MiniConf().set(SWAP_KEY, SWAP_VALUE))
+    assert stock == swapped
+    # the swap genuinely instantiated the framework plane
+    from sparkrdma_tpu.shuffle import TpuShuffleManager
+
+    assert isinstance(ctx.driver, TpuShuffleManager)
+    assert all(isinstance(e, TpuShuffleManager) for e in ctx.executors)
+    # and the stock run never touched it
+    from minispark import BuiltinShuffleManager
+
+    assert isinstance(stock_ctx.driver, BuiltinShuffleManager)
+
+
+def test_driver_port_written_back_into_engine_conf():
+    # SparkConf semantics (RdmaShuffleManager.scala:183-184): the driver
+    # records its negotiated listener port in the ENGINE's own mapping
+    # so executors constructed from it later can connect
+    conf = MiniConf().set(SWAP_KEY, SWAP_VALUE)
+    ctx = MiniSparkContext(conf)
+    try:
+        assert conf.get("tpu.shuffle.driverPort") is not None
+        assert int(conf["tpu.shuffle.driverPort"]) == ctx.driver.node.port
+    finally:
+        ctx.stop()
+
+
+def test_swap_works_over_native_transport():
+    from sparkrdma_tpu.native.transport_lib import available
+
+    if not available():
+        pytest.skip("native transport unavailable")
+    stock, _ = _run()
+    conf = (
+        MiniConf()
+        .set(SWAP_KEY, SWAP_VALUE)
+        .set("tpu.shuffle.transport", "native")
+    )
+    swapped, ctx = _run(conf)
+    assert stock == swapped
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    assert isinstance(ctx.driver.node, NativeTpuNode)
+
+
+def test_engine_only_speaks_the_documented_spi():
+    """The engine module must not import the framework at module scope
+    (only the config-key class path connects them)."""
+    import ast
+    import inspect
+
+    import minispark
+
+    tree = ast.parse(inspect.getsource(minispark))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names]
+            mod = getattr(node, "module", "") or ""
+            assert not mod.startswith("sparkrdma_tpu"), mod
+            assert not any(n.startswith("sparkrdma_tpu") for n in names), names
