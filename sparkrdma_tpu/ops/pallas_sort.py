@@ -1,0 +1,167 @@
+"""Pallas bitonic merge kernels — the device sort's hot path.
+
+``ops.sort.bitonic_merge_sort`` decomposes a flat sort into one cheap
+row-wise ``jnp.sort`` plus log2(R) rounds of pairwise bitonic merges.
+Expressed as plain XLA ops every merge stage round-trips the full array
+through HBM (measured 0.11 GB/s on v5e — 8x SLOWER than a flat
+``jnp.sort``); the comparator network only wins if consecutive stages
+stay in VMEM. That is exactly what these kernels do:
+
+- :func:`merge_block` — one grid program loads a whole 2D-element block
+  (<= ~2 MiB), runs EVERY remaining compare-exchange stage
+  (d = D .. 1, sublane regime then lane regime) on-chip, and writes the
+  block once: log2(2D) stages for a single HBM round trip.
+- :func:`apply_stage` — the handful of stages whose distance exceeds
+  the VMEM block span, as a free-reshape XLA elementwise pass
+  (bandwidth-bound, one read + one write).
+
+Roofline (docs/DESIGN.md §6): a comparison sort of n=32M uint32 needs
+~log2(L)^2/2 + sum stages ~= 400 vectorized compare-exchange stages;
+the VPU, not HBM, is the binding resource once stages fuse in VMEM.
+Scatter-based radix passes are measured 3-6x slower than sorting on
+this hardware, so the bitonic decomposition is the right ceiling to
+chase. Reference role: the in-memory merge-sort the reference delegates
+to Spark's sort shuffle (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+# max elements a merge_block program holds in VMEM (uint32): 2^19 = 2 MiB
+MAX_BLOCK_ELEMS = 1 << 19
+
+
+def _stages_in_registers(w: jax.Array, first_d: int) -> jax.Array:
+    """Compare-exchange stages ``first_d .. 1`` on ``w`` ([S, 128],
+    row-major flat order). Pure value ops — usable inside a kernel."""
+    s = w.shape[0]
+    d = first_d
+    while d >= LANES:
+        dr = d // LANES
+        w4 = w.reshape(s // (2 * dr), 2, dr, LANES)
+        lo = jnp.minimum(w4[:, 0], w4[:, 1])
+        hi = jnp.maximum(w4[:, 0], w4[:, 1])
+        w = jnp.concatenate([lo[:, None], hi[:, None]], axis=1).reshape(s, LANES)
+        d //= 2
+    while d >= 1:
+        w4 = w.reshape(s, LANES // (2 * d), 2, d)
+        lo = jnp.minimum(w4[:, :, 0], w4[:, :, 1])
+        hi = jnp.maximum(w4[:, :, 0], w4[:, :, 1])
+        w = jnp.concatenate([lo[:, :, None], hi[:, :, None]], axis=2).reshape(
+            s, LANES
+        )
+        d //= 2
+    return w
+
+
+def _merge_block_kernel(v_ref, out_ref, *, flip: bool, first_d: int):
+    w = v_ref[0]  # [S, 128]
+    s = w.shape[0]
+    if flip:
+        # rows are (ascending ++ ascending); reversing the second half
+        # (both axes = full sequence reversal) makes the block bitonic
+        top = w[: s // 2]
+        desc = w[s // 2 :][::-1, ::-1]
+        lo = jnp.minimum(top, desc)
+        hi = jnp.maximum(top, desc)
+        w = jnp.concatenate([lo, hi], axis=0)
+        w = _stages_in_registers(w, first_d // 2)
+    else:
+        w = _stages_in_registers(w, first_d)
+    out_ref[0] = w
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def merge_block(
+    x: jax.Array, block_elems: int, flip: bool, interpret: bool = False
+) -> jax.Array:
+    """Apply all remaining bitonic stages inside each ``block_elems``
+    block of flat ``x`` (power-of-two sizes).
+
+    ``flip=True``: each block is two sorted ascending runs -> merged.
+    ``flip=False``: each block is already bitonic (stages > block span
+    were applied by :func:`apply_stage`) -> finished."""
+    (n,) = x.shape
+    s = block_elems // LANES
+    v3 = x.reshape(n // block_elems, s, LANES)
+    out = pl.pallas_call(
+        functools.partial(
+            _merge_block_kernel, flip=flip, first_d=block_elems // 2
+        ),
+        out_shape=jax.ShapeDtypeStruct(v3.shape, x.dtype),
+        grid=(v3.shape[0],),
+        in_specs=[
+            pl.BlockSpec((1, s, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((1, s, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(v3)
+    return out.reshape(n)
+
+
+def apply_stage(x: jax.Array, d: int) -> jax.Array:
+    """One compare-exchange stage at distance ``d`` as a plain XLA
+    elementwise pass (for distances too wide for a VMEM block). The
+    reshapes are layout-free (row-major splits)."""
+    (n,) = x.shape
+    w = x.reshape(n // (2 * d), 2, d)
+    lo = jnp.minimum(w[:, 0], w[:, 1])
+    hi = jnp.maximum(w[:, 0], w[:, 1])
+    return jnp.concatenate([lo[:, None], hi[:, None]], axis=1).reshape(n)
+
+
+def flip_odd_pairs(x: jax.Array, run_len: int) -> jax.Array:
+    """Reverse every second ``run_len`` run so (asc, asc) pairs become
+    bitonic (asc, desc) — the pre-pass for rounds whose first stage runs
+    in :func:`apply_stage` rather than in-kernel."""
+    (n,) = x.shape
+    w = x.reshape(n // (2 * run_len), 2, run_len)
+    return jnp.concatenate([w[:, :1], w[:, 1:, ::-1]], axis=1).reshape(n)
+
+
+def sort_flat(
+    x: jax.Array, row_len: int = 8192, interpret: bool = None
+) -> jax.Array:
+    """Total ascending sort of a flat power-of-two uint array.
+
+    Pipeline: row-wise ``jnp.sort`` (VMEM-friendly, the measured fast
+    direction on TPU) -> per-round pairwise merges. Rounds whose pair
+    fits a VMEM block run entirely in one :func:`merge_block` call;
+    wider rounds run their wide stages via :func:`apply_stage` and
+    finish in one :func:`merge_block` pass."""
+    (n,) = x.shape
+    if n & (n - 1):
+        raise ValueError("sort_flat requires a power-of-two length")
+    if row_len & (row_len - 1) or row_len < LANES:
+        raise ValueError("row_len must be a power of two >= 128")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if n <= max(row_len, MAX_BLOCK_ELEMS):
+        return jnp.sort(x)
+    v = jnp.sort(x.reshape(n // row_len, row_len), axis=1).reshape(n)
+    length = row_len
+    while length < n:
+        pair = 2 * length
+        if pair <= MAX_BLOCK_ELEMS:
+            v = merge_block(v, pair, True, interpret)
+        else:
+            # wide stages in HBM: flip odd runs, then distances
+            # pair/2 .. MAX_BLOCK_ELEMS/2; blocks of MAX_BLOCK_ELEMS are
+            # then bitonic and finish on-chip
+            v = flip_odd_pairs(v, length)
+            d = pair // 2
+            while d >= MAX_BLOCK_ELEMS:
+                v = apply_stage(v, d)
+                d //= 2
+            v = merge_block(v, MAX_BLOCK_ELEMS, False, interpret)
+        length = pair
+    return v
