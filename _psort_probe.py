@@ -1,0 +1,61 @@
+import time, numpy as np
+import jax, jax.numpy as jnp
+import sparkrdma_tpu.ops.pallas_sort as ps
+
+rng = np.random.default_rng(0)
+dev = jax.devices()[0]
+print("device:", dev, flush=True)
+
+N = 1 << 25
+keys = rng.integers(0, 1 << 32, size=N, dtype=np.uint32)
+x32 = jax.device_put(
+    (keys.astype(np.int64) - (1 << 31)).astype(np.int32), dev
+)
+ref32 = np.sort(np.asarray(x32))
+
+# 1. presort alone
+t0 = time.perf_counter()
+f_pre = jax.jit(lambda v: ps.presort_rows(v, 8192))
+r = jax.block_until_ready(f_pre(x32))
+print(f"presort compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter(); jax.block_until_ready(f_pre(x32))
+t = time.perf_counter() - t0
+print(f"presort {t*1e3:.1f}ms -> {N*4/t/1e9:.1f} GB/s", flush=True)
+
+# 2. merge_block alone (one pass, k=2*block)
+B = ps.MAX_BLOCK_ELEMS
+t0 = time.perf_counter()
+mb = jax.block_until_ready(ps.merge_block(r, B, 2 * B, False))
+print(f"merge_block compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+jax.block_until_ready(ps.merge_block(r, B, 2 * B, False))
+t = time.perf_counter() - t0
+print(f"merge_block {t*1e3:.1f}ms -> {N*4/t/1e9:.1f} GB/s", flush=True)
+
+# 3. local_sort_blocks
+t0 = time.perf_counter()
+ls = jax.block_until_ready(ps.local_sort_blocks(r, 8192, B, False))
+print(f"local_sort compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter()
+jax.block_until_ready(ps.local_sort_blocks(r, 8192, B, False))
+t = time.perf_counter() - t0
+print(f"local_sort {t*1e3:.1f}ms -> {N*4/t/1e9:.1f} GB/s", flush=True)
+
+# 4. full sort
+t0 = time.perf_counter()
+f = jax.jit(lambda v: ps.sort_flat(v))
+got = jax.block_until_ready(f(x32))
+print(f"sort_flat compile+run {time.perf_counter()-t0:.1f}s", flush=True)
+assert np.array_equal(np.asarray(got), ref32), "WRONG"
+print("correct on chip", flush=True)
+for _ in range(3):
+    t0 = time.perf_counter(); jax.block_until_ready(f(x32))
+    t = time.perf_counter() - t0
+    print(f"sort_flat {t*1e3:.1f}ms -> {N*4/t/1e9:.2f} GB/s", flush=True)
+
+# baseline
+fb = jax.jit(jnp.sort)
+jax.block_until_ready(fb(x32))
+t0 = time.perf_counter(); jax.block_until_ready(fb(x32))
+t = time.perf_counter() - t0
+print(f"flat jnp.sort {t*1e3:.1f}ms -> {N*4/t/1e9:.2f} GB/s", flush=True)

@@ -1,27 +1,33 @@
-"""Pallas bitonic merge kernels — the device sort's hot path.
+"""Pallas bitonic sort kernels — the device sort's hot path.
 
 ``ops.sort.bitonic_merge_sort`` decomposes a flat sort into one cheap
 row-wise ``jnp.sort`` plus log2(R) rounds of pairwise bitonic merges.
 Expressed as plain XLA ops every merge stage round-trips the full array
 through HBM (measured 0.11 GB/s on v5e — 8x SLOWER than a flat
 ``jnp.sort``); the comparator network only wins if consecutive stages
-stay in VMEM. That is exactly what these kernels do:
+stay in VMEM. That is what these kernels do, using the classic
+*alternating-direction* bitonic network (Batcher): element ``i`` of a
+round with run length ``k`` sorts ascending iff bit ``log2(k)`` of
+``i`` is 0 — no sequence reversal anywhere (Pallas TPU has no ``rev``
+lowering), just a per-run min/max swap selected by that bit.
 
-- :func:`merge_block` — one grid program loads a whole 2D-element block
-  (<= ~2 MiB), runs EVERY remaining compare-exchange stage
-  (d = D .. 1, sublane regime then lane regime) on-chip, and writes the
-  block once: log2(2D) stages for a single HBM round trip.
-- :func:`apply_stage` — the handful of stages whose distance exceeds
-  the VMEM block span, as a free-reshape XLA elementwise pass
-  (bandwidth-bound, one read + one write).
+- :func:`local_sort_blocks` — one grid program loads a whole block
+  (<= ~2 MiB) and runs EVERY round from the pre-sorted row length up to
+  the block size on-chip: ~100 compare-exchange stages for a single HBM
+  round trip.
+- :func:`merge_block` — for rounds wider than a block, the tail stages
+  (distance <= block/2) fused into one pass; the run direction is
+  uniform per block and derived from ``program_id``.
+- :func:`apply_stage` — the few stages whose distance exceeds the VMEM
+  block span, as a free-reshape XLA elementwise pass (bandwidth-bound,
+  one read + one write).
 
-Roofline (docs/DESIGN.md §6): a comparison sort of n=32M uint32 needs
-~log2(L)^2/2 + sum stages ~= 400 vectorized compare-exchange stages;
-the VPU, not HBM, is the binding resource once stages fuse in VMEM.
-Scatter-based radix passes are measured 3-6x slower than sorting on
-this hardware, so the bitonic decomposition is the right ceiling to
-chase. Reference role: the in-memory merge-sort the reference delegates
-to Spark's sort shuffle (SURVEY.md §3.3).
+Roofline (docs/DESIGN.md §6): for n=32M uint32 the pipeline is one XLA
+row sort + 1 local-sort pass + 6 merge passes + 21 wide stages ~= 29
+full-array HBM round trips ~= 7.8 GB of traffic; at v5e's ~800 GB/s
+that bounds the sort at ~13 GB/s — an order of magnitude above the
+1.5 GB/s flat ``jnp.sort``. Reference role: the in-memory merge-sort
+the reference delegates to Spark's sort shuffle (SURVEY.md §3.3).
 """
 
 from __future__ import annotations
@@ -34,66 +40,122 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
-# max elements a merge_block program holds in VMEM (uint32): 2^19 = 2 MiB
+# max elements a kernel program holds in VMEM (uint32): 2^19 = 2 MiB
 MAX_BLOCK_ELEMS = 1 << 19
 
 
-def _stages_in_registers(w: jax.Array, first_d: int) -> jax.Array:
-    """Compare-exchange stages ``first_d .. 1`` on ``w`` ([S, 128],
-    row-major flat order). Pure value ops — usable inside a kernel."""
+def _roll(w: jax.Array, shift: int, interpret: bool) -> jax.Array:
+    return jnp.roll(w, shift, axis=1) if interpret else pltpu.roll(w, shift, 1)
+
+
+def _ce_stages(
+    w: jax.Array, kr: int, first_d: int, row0, interpret: bool
+) -> jax.Array:
+    """Compare-exchange stages ``first_d .. 1`` on ``w`` ([S, 128] in
+    row-major flat order), within runs of ``kr`` rows; the run holding
+    global row ``row0 + r`` sorts ascending iff its index is even (i.e.
+    ascending iff bit log2(k) of the flat element index is 0 — Batcher's
+    alternating-direction network). ``row0`` may be traced (program_id
+    arithmetic). Pure value ops — usable inside a kernel.
+
+    Sublane stages (d >= 128) are free row-major reshapes; lane stages
+    (d < 128) use cyclic lane rolls with an XOR-partner mask, because
+    Mosaic cannot reshape across the lane dimension."""
     s = w.shape[0]
     d = first_d
     while d >= LANES:
         dr = d // LANES
-        w4 = w.reshape(s // (2 * dr), 2, dr, LANES)
-        lo = jnp.minimum(w4[:, 0], w4[:, 1])
-        hi = jnp.maximum(w4[:, 0], w4[:, 1])
-        w = jnp.concatenate([lo[:, None], hi[:, None]], axis=1).reshape(s, LANES)
+        g = s // (2 * dr)
+        gi = jax.lax.broadcasted_iota(jnp.int32, (g, 1), 0)[:, 0]
+        asc = ((((row0 + gi * (2 * dr)) // kr) & 1) == 0).reshape(g, 1, 1)
+        w4 = w.reshape(g, 2, dr, LANES)
+        a, b = w4[:, 0], w4[:, 1]
+        lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+        first = jnp.where(asc, lo, hi)
+        second = jnp.where(asc, hi, lo)
+        w = jnp.concatenate(
+            [first[:, None], second[:, None]], axis=1
+        ).reshape(s, LANES)
         d //= 2
-    while d >= 1:
-        w4 = w.reshape(s, LANES // (2 * d), 2, d)
-        lo = jnp.minimum(w4[:, :, 0], w4[:, :, 1])
-        hi = jnp.maximum(w4[:, :, 0], w4[:, :, 1])
-        w = jnp.concatenate([lo[:, :, None], hi[:, :, None]], axis=2).reshape(
-            s, LANES
-        )
-        d //= 2
+    if d >= 1:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (s, LANES), 1)
+        ri = jax.lax.broadcasted_iota(jnp.int32, (s, 1), 0)
+        ascw = (((row0 + ri) // kr) & 1) == 0  # (s, 1)
+        while d >= 1:
+            # partner of lane l is l ^ d: from l+d when bit d clear
+            # (cyclic roll by LANES-d), else from l-d (roll by d)
+            up = _roll(w, LANES - d, interpret)
+            down = _roll(w, d, interpret)
+            low_side = (lane & d) == 0
+            partner = jnp.where(low_side, up, down)
+            lo = jnp.minimum(w, partner)
+            hi = jnp.maximum(w, partner)
+            w = jnp.where(low_side == ascw, lo, hi)
+            d //= 2
     return w
 
 
-def _merge_block_kernel(v_ref, out_ref, *, flip: bool, first_d: int):
+def _local_sort_kernel(v_ref, out_ref, *, row_len: int, block: int,
+                       interpret: bool):
     w = v_ref[0]  # [S, 128]
     s = w.shape[0]
-    if flip:
-        # rows are (ascending ++ ascending); reversing the second half
-        # (both axes = full sequence reversal) makes the block bitonic
-        top = w[: s // 2]
-        desc = w[s // 2 :][::-1, ::-1]
-        lo = jnp.minimum(top, desc)
-        hi = jnp.maximum(top, desc)
-        w = jnp.concatenate([lo, hi], axis=0)
-        w = _stages_in_registers(w, first_d // 2)
-    else:
-        w = _stages_in_registers(w, first_d)
+    row0 = pl.program_id(0) * s
+    k = 2 * row_len
+    while k <= block:
+        w = _ce_stages(w, k // LANES, k // 2, row0, interpret)
+        k *= 2
     out_ref[0] = w
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def merge_block(
-    x: jax.Array, block_elems: int, flip: bool, interpret: bool = False
+def local_sort_blocks(
+    x: jax.Array, row_len: int, block: int, interpret: bool = False
 ) -> jax.Array:
-    """Apply all remaining bitonic stages inside each ``block_elems``
-    block of flat ``x`` (power-of-two sizes).
-
-    ``flip=True``: each block is two sorted ascending runs -> merged.
-    ``flip=False``: each block is already bitonic (stages > block span
-    were applied by :func:`apply_stage`) -> finished."""
+    """All bitonic rounds from run length ``2*row_len`` up to ``block``,
+    fused into one HBM round trip. Input: flat ``x`` whose ``row_len``
+    runs alternate ascending/descending; output: ``block`` runs
+    alternating ascending/descending (run ``b`` ascending iff even)."""
     (n,) = x.shape
-    s = block_elems // LANES
-    v3 = x.reshape(n // block_elems, s, LANES)
+    s = block // LANES
+    v3 = x.reshape(n // block, s, LANES)
+    out = pl.pallas_call(
+        functools.partial(_local_sort_kernel, row_len=row_len, block=block,
+                          interpret=interpret),
+        out_shape=jax.ShapeDtypeStruct(v3.shape, x.dtype),
+        grid=(v3.shape[0],),
+        in_specs=[
+            pl.BlockSpec((1, s, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((1, s, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(v3)
+    return out.reshape(n)
+
+
+def _merge_block_kernel(v_ref, out_ref, *, first_d: int, kr: int,
+                        interpret: bool):
+    w = v_ref[0]  # [S, 128]
+    s = w.shape[0]
+    row0 = pl.program_id(0) * s
+    out_ref[0] = _ce_stages(w, kr, first_d, row0, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def merge_block(
+    x: jax.Array, block: int, k: int, interpret: bool = False
+) -> jax.Array:
+    """Stages ``block/2 .. 1`` of the run-length-``k`` round (``k >
+    block``; wider stages were applied by :func:`apply_stage`) inside
+    each ``block``-element tile of flat ``x``."""
+    (n,) = x.shape
+    s = block // LANES
+    v3 = x.reshape(n // block, s, LANES)
     out = pl.pallas_call(
         functools.partial(
-            _merge_block_kernel, flip=flip, first_d=block_elems // 2
+            _merge_block_kernel, first_d=block // 2, kr=k // LANES,
+            interpret=interpret
         ),
         out_shape=jax.ShapeDtypeStruct(v3.shape, x.dtype),
         grid=(v3.shape[0],),
@@ -108,24 +170,36 @@ def merge_block(
     return out.reshape(n)
 
 
-def apply_stage(x: jax.Array, d: int) -> jax.Array:
-    """One compare-exchange stage at distance ``d`` as a plain XLA
-    elementwise pass (for distances too wide for a VMEM block). The
-    reshapes are layout-free (row-major splits)."""
+def apply_stage(x: jax.Array, d: int, k: int) -> jax.Array:
+    """One compare-exchange stage at distance ``d`` of the
+    run-length-``k`` round, as a plain XLA elementwise pass (for
+    distances too wide for a VMEM block). The reshapes are layout-free
+    (row-major splits); direction alternates per run."""
     (n,) = x.shape
-    w = x.reshape(n // (2 * d), 2, d)
-    lo = jnp.minimum(w[:, 0], w[:, 1])
-    hi = jnp.maximum(w[:, 0], w[:, 1])
-    return jnp.concatenate([lo[:, None], hi[:, None]], axis=1).reshape(n)
+    w = x.reshape(n // k, k // (2 * d), 2, d)
+    a, b = w[:, :, 0], w[:, :, 1]
+    lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+    asc = (jnp.arange(n // k, dtype=jnp.int32) % 2 == 0).reshape(-1, 1, 1)
+    first = jnp.where(asc, lo, hi)
+    second = jnp.where(asc, hi, lo)
+    return jnp.concatenate(
+        [first[:, :, None], second[:, :, None]], axis=2
+    ).reshape(n)
 
 
-def flip_odd_pairs(x: jax.Array, run_len: int) -> jax.Array:
-    """Reverse every second ``run_len`` run so (asc, asc) pairs become
-    bitonic (asc, desc) — the pre-pass for rounds whose first stage runs
-    in :func:`apply_stage` rather than in-kernel."""
+def presort_rows(x: jax.Array, row_len: int) -> jax.Array:
+    """Sort each ``row_len`` run, directions alternating asc/desc.
+
+    Descending is done by bit-flipping odd rows around an ascending
+    sort (``~x = -x-1`` reverses signed order, and all-ones XOR
+    reverses unsigned order) — elementwise, no lane reversal (which
+    would be a relayout on TPU)."""
     (n,) = x.shape
-    w = x.reshape(n // (2 * run_len), 2, run_len)
-    return jnp.concatenate([w[:, :1], w[:, 1:, ::-1]], axis=1).reshape(n)
+    r = n // row_len
+    ones = ~jnp.zeros((), x.dtype)
+    mask = jnp.where((jnp.arange(r) & 1) == 1, ones, jnp.zeros((), x.dtype))
+    mask = mask[:, None]
+    return (jnp.sort(x.reshape(r, row_len) ^ mask, axis=1) ^ mask).reshape(n)
 
 
 def sort_flat(
@@ -133,35 +207,42 @@ def sort_flat(
 ) -> jax.Array:
     """Total ascending sort of a flat power-of-two uint array.
 
-    Pipeline: row-wise ``jnp.sort`` (VMEM-friendly, the measured fast
-    direction on TPU) -> per-round pairwise merges. Rounds whose pair
-    fits a VMEM block run entirely in one :func:`merge_block` call;
-    wider rounds run their wide stages via :func:`apply_stage` and
-    finish in one :func:`merge_block` pass."""
+    Pipeline: alternating-direction row pre-sort (XLA ``jnp.sort``, the
+    measured fast direction on TPU) -> one :func:`local_sort_blocks`
+    pass fusing every round that fits a VMEM block -> per wider round,
+    its wide stages via :func:`apply_stage` and the in-block tail via
+    :func:`merge_block`. The final round (k = n) has every direction
+    bit 0, so the output is fully ascending."""
     (n,) = x.shape
     if n & (n - 1):
         raise ValueError("sort_flat requires a power-of-two length")
-    if row_len & (row_len - 1) or row_len < LANES:
-        raise ValueError("row_len must be a power of two >= 128")
+    if row_len & (row_len - 1) or row_len < 2 * LANES:
+        raise ValueError("row_len must be a power of two >= 256")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if n <= max(row_len, MAX_BLOCK_ELEMS):
+    block = MAX_BLOCK_ELEMS
+    if n <= max(row_len, block):
         return jnp.sort(x)
-    v = jnp.sort(x.reshape(n // row_len, row_len), axis=1).reshape(n)
-    length = row_len
-    while length < n:
-        pair = 2 * length
-        if pair <= MAX_BLOCK_ELEMS:
-            v = merge_block(v, pair, True, interpret)
-        else:
-            # wide stages in HBM: flip odd runs, then distances
-            # pair/2 .. MAX_BLOCK_ELEMS/2; blocks of MAX_BLOCK_ELEMS are
-            # then bitonic and finish on-chip
-            v = flip_odd_pairs(v, length)
-            d = pair // 2
-            while d >= MAX_BLOCK_ELEMS:
-                v = apply_stage(v, d)
-                d //= 2
-            v = merge_block(v, MAX_BLOCK_ELEMS, False, interpret)
-        length = pair
+    # Mosaic has no unsigned vector min/max (arith.minui); bias uint32
+    # into int32 order-preservingly (flip the sign bit) at the boundary
+    unsigned = jnp.issubdtype(x.dtype, jnp.unsignedinteger)
+    if unsigned:
+        in_dtype = x.dtype
+        x = jax.lax.bitcast_convert_type(
+            x ^ jnp.asarray(1 << 31, x.dtype), jnp.int32
+        )
+    v = presort_rows(x, row_len)
+    v = local_sort_blocks(v, row_len, block, interpret)
+    k = 2 * block
+    while k <= n:
+        d = k // 2
+        while d >= block:
+            v = apply_stage(v, d, k)
+            d //= 2
+        v = merge_block(v, block, k, interpret)
+        k *= 2
+    if unsigned:
+        v = jax.lax.bitcast_convert_type(v, in_dtype) ^ jnp.asarray(
+            1 << 31, in_dtype
+        )
     return v

@@ -1,0 +1,86 @@
+"""Pallas bitonic sort: correctness of every pipeline piece in
+interpreter mode (conftest pins CPU), with MAX_BLOCK_ELEMS shrunk so the
+multi-round wide-stage path is exercised at test sizes.
+
+The network sorts via Batcher's alternating-direction formulation —
+element i of a run-length-k round ascends iff bit log2(k) of i is 0 —
+so there is no sequence reversal anywhere (Pallas TPU has no ``rev``
+lowering; reference role: the reduce-side merge-sort, SURVEY.md §3.3).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sparkrdma_tpu.ops.pallas_sort as ps
+
+
+@pytest.fixture
+def small_block(monkeypatch):
+    monkeypatch.setattr(ps, "MAX_BLOCK_ELEMS", 1 << 12)
+
+
+def _rand(n, seed=0, dtype=np.uint32):
+    rng = np.random.default_rng(seed)
+    if dtype == np.uint32:
+        return rng.integers(0, 1 << 32, size=n, dtype=np.uint32)
+    return rng.integers(-(1 << 31), 1 << 31, size=n, dtype=np.int32)
+
+
+def test_presort_rows_alternates_directions():
+    x = jnp.asarray(_rand(1024, dtype=np.int32))
+    v = np.asarray(ps.presort_rows(x, 256)).reshape(4, 256)
+    for r in range(4):
+        expect = np.sort(np.asarray(x).reshape(4, 256)[r])
+        if r % 2:
+            expect = expect[::-1]
+        assert np.array_equal(v[r], expect)
+
+
+@pytest.mark.parametrize("n_log", [13, 14, 16])
+@pytest.mark.parametrize("dtype", [np.uint32, np.int32])
+def test_sort_flat_small_blocks(small_block, n_log, dtype):
+    """Exercises presort -> local_sort_blocks -> apply_stage ->
+    merge_block across several wide rounds."""
+    x = _rand(1 << n_log, seed=n_log, dtype=dtype)
+    got = np.asarray(ps.sort_flat(jnp.asarray(x), row_len=512))
+    assert got.dtype == x.dtype
+    assert np.array_equal(got, np.sort(x))
+
+
+def test_sort_flat_skewed_keys(small_block):
+    """Constant runs and near-sorted data (degenerate comparator
+    inputs)."""
+    n = 1 << 13
+    x = np.concatenate(
+        [np.zeros(n // 2, np.uint32), np.full(n // 2, 7, np.uint32)]
+    )
+    got = np.asarray(ps.sort_flat(jnp.asarray(x), row_len=512))
+    assert np.array_equal(got, np.sort(x))
+    y = np.arange(n, dtype=np.uint32)[::-1].copy()
+    got = np.asarray(ps.sort_flat(jnp.asarray(y), row_len=512))
+    assert np.array_equal(got, np.arange(n, dtype=np.uint32))
+
+
+def test_sort_flat_small_n_falls_back():
+    x = _rand(1 << 10)
+    got = np.asarray(ps.sort_flat(jnp.asarray(x)))
+    assert np.array_equal(got, np.sort(x))
+
+
+def test_sort_flat_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="power-of-two"):
+        ps.sort_flat(jnp.zeros(1000, jnp.uint32))
+    with pytest.raises(ValueError, match="row_len"):
+        ps.sort_flat(jnp.zeros(1 << 13, jnp.uint32), row_len=100)
+
+
+def test_sort_flat_jit_composes(small_block):
+    """sort_flat must trace cleanly inside an outer jit (the bench and
+    TeraSorter call it under jit)."""
+    x = _rand(1 << 13, seed=3)
+    f = jax.jit(lambda v: ps.sort_flat(v, row_len=512).sum())
+    expect = int(np.sort(x).astype(np.uint64).sum() & 0xFFFFFFFF)
+    assert int(f(jnp.asarray(x))) == expect
