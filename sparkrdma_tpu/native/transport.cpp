@@ -1204,6 +1204,7 @@ void loop_main(Node* n) {
             if (r > 0) {
               pr->received += (uint64_t)r;
               if (pr->received == pr->expected) {
+                n->stat_streamed_reads++;
                 Completion comp{};
                 comp.kind = COMP_READ_DONE;
                 comp.status = ST_OK;
