@@ -35,7 +35,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkrdma_tpu.ops.sort import merge_received, pack_by_partition, radix_partition
+from sparkrdma_tpu.ops.sort import device_sort, merge_received, split_sorted
 from sparkrdma_tpu.parallel.mesh import make_mesh, shard_spec
 
 KEY_BITS = 32
@@ -70,15 +70,22 @@ class TeraSorter:
 
         def shard_fn(keys):  # keys: [n_local] uint32 on one device
             if e == 1:
-                # single-shard short circuit: no pack, no exchange — the
+                # single-shard short circuit: no split, no exchange — the
                 # reference's invariant #2 (local partitions never loop
-                # through the network, RdmaShuffleFetcherIterator.scala:328-339)
-                merged = jnp.sort(keys)
+                # through the network, RdmaShuffleFetcherIterator.scala:328-339).
+                # device_sort == lax.sort, the measured optimum for this
+                # chip (ops/sort.py module doc, DESIGN.md §6) — the same
+                # delegation the reference makes to Spark's sort writers.
+                merged = device_sort(keys)
                 total = jnp.asarray([keys.shape[0]], jnp.int32)
                 return merged, total, jnp.zeros((), jnp.int32)
-            dest = radix_partition(keys, e, KEY_BITS)
-            slab, counts, overflowed = pack_by_partition(
-                keys, dest, e, capacity, fill=int(SENTINEL)
+            # local sort FIRST: destinations are key ranges, so sorted
+            # keys are grouped by destination and the send slab falls out
+            # of range-edge slices — measured ~25x cheaper than the
+            # argsort/scatter pack at 32M keys (benchmarks/sort_study.py)
+            local = device_sort(keys)
+            slab, counts, overflowed = split_sorted(
+                local, e, capacity, KEY_BITS, fill=int(SENTINEL)
             )
             # one all_to_all delivers every peer's bucket — the one-sided
             # READ plane collapsed into a single XLA collective

@@ -5,14 +5,37 @@ blocks (sort-shuffle files or registered chunks, SURVEY.md §3.3); the
 read path re-aggregates blocks per source (§3.4). On TPU the same
 stages become dense vector ops that XLA fuses:
 
+- ``device_sort``: the framework's exact device sort — ``lax.sort``,
+  chosen by measurement (see below), the primitive under every other
+  op here,
 - ``radix_partition``: dest-partition assignment from the key's top
   bits (the range partitioner of TeraSort),
-- ``pack_by_partition``: stable counting-sort layout into a
-  [num_partitions, capacity] bucketed send slab + counts — static
+- ``split_sorted``: partition an already-sorted key array into a
+  [num_partitions, capacity] bucketed send slab by slicing at the
+  radix range boundaries — the fast path when keys are sortable by
+  their destination (TeraSort), measured ~25x cheaper than the
+  scatter-based general pack at 32M keys,
+- ``pack_by_partition``: stable counting-sort layout into the same
+  slab shape for arbitrary (dest, value) pairs (hash joins) — static
   shapes with a length prefix per row, overflow *detected* rather than
   avoided (host re-runs with the next bucket class, like the pool's
   power-of-two re-rounding),
 - ``merge_received``: mask + sort of the post-exchange slab.
+
+Why ``lax.sort`` and not a bespoke kernel (measured on a v5e chip,
+reproduce with ``benchmarks/sort_study.py``; full table in
+docs/DESIGN.md §6): a flat 32M-u32 ``lax.sort`` runs at ~83 ms — the
+VPU comparator roofline for a ~310-stage bitonic network, executing at
+~0.25 ms/stage. Short-row sorts are far cheaper per pass (3.9 ms for
+[131072, 256]), but completing them into a total sort needs ~290 merge
+stages that cost ~1.4-3.6 ms EACH when composed from jnp reshape +
+min/max (XLA fuses its own sort stages ~5-14x better than anything
+expressible at the jnp level), and scatter-based radix passes run at
+0.06-0.55 GB/s. Every expressible decomposition we measured or bounded
+costs 3-6x the flat sort. This mirrors the reference exactly: SparkRDMA
+never replaced Spark's sort machinery — it delegated to Spark's own
+sort writers (RdmaWrapperShuffleWriter.scala:85-101) and accelerated
+the byte plane underneath. We delegate to XLA's sort and do the same.
 
 All functions are jit-safe (static shapes, no data-dependent Python
 control flow).
@@ -24,6 +47,21 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def device_sort(x: jax.Array) -> jax.Array:
+    """The framework's exact device sort (ascending, any shape's last axis
+    or flat 1-D).
+
+    Implementation: ``jnp.sort`` (XLA's fused bitonic-network lowering),
+    selected by measurement over row-wise decompositions, jnp-composed
+    merge trees, Pallas compare-exchange kernels, and scatter-based
+    radix passes — see the module docstring and docs/DESIGN.md §6. The
+    reference delegates sorting to Spark's sort writers the same way
+    (RdmaWrapperShuffleWriter.scala:85-101); the transport planes are
+    where this framework spends its own silicon.
+    """
+    return jnp.sort(x)
 
 
 def radix_partition(keys: jax.Array, num_partitions: int, key_bits: int = 32) -> jax.Array:
@@ -70,56 +108,56 @@ def pack_by_partition(
     return slab, jnp.minimum(counts, capacity), overflowed
 
 
-def _bitonic_merge_rows(v: jax.Array) -> jax.Array:
-    """Bitonic merge of each row of ``v`` ([R, L], every row a bitonic
-    sequence, L a power of two) into ascending order: log2(L) fully
-    vectorized compare-exchange stages along the lane dimension."""
-    rows, length = v.shape
-    d = length // 2
-    while d >= 1:
-        w = v.reshape(rows, length // (2 * d), 2, d)
-        lo = jnp.minimum(w[:, :, 0, :], w[:, :, 1, :])
-        hi = jnp.maximum(w[:, :, 0, :], w[:, :, 1, :])
-        v = jnp.stack([lo, hi], axis=2).reshape(rows, length)
-        d //= 2
-    return v
+def split_sorted(
+    sorted_keys: jax.Array, num_partitions: int, capacity: int,
+    key_bits: int = 32, fill: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Bucketed send slab from an ALREADY-SORTED key array.
 
+    TeraSort's destination is a key-range (top bits), so locally-sorted
+    keys are already grouped by destination: the per-partition runs are
+    contiguous and found with a searchsorted against the range edges —
+    no argsort, no scatter. Each run is laid into its slab row with one
+    masked gather at a dynamic offset. Measured (v5e, 32M keys,
+    benchmarks/sort_study.py): the scatter-based general pack costs
+    ~2.1 s/step; local sort (83 ms) + this split is ~25x cheaper — the
+    packing strategy the SPMD TeraSort step uses.
 
-def bitonic_merge_sort(x: jax.Array, row_len: int = 4096) -> jax.Array:
-    """Total sort of a flat array: sorted rows + pairwise bitonic merges.
-
-    TPU-measured motivation (docs/DESIGN.md §6): one flat ``jnp.sort``
-    of 32M keys costs ~10x more than the same data sorted as rows along
-    the lane axis, and scatter-based radix passes are 3-6x slower than
-    sorting itself — so the winning decomposition is (1) sort [R, L]
-    rows in one cheap pass, then (2) log2(R) rounds of pairwise bitonic
-    merges, each a short chain of vectorized min/max at halving strides.
-    Comparator stages: log2(L)^2/2 + sum_{k} log2(2^k L) vs the flat
-    sort's log2(n)^2/2 — ~2.6x fewer at n=32M, all in layouts XLA tiles
-    well.
-
-    Handles any length by padding to a power-of-two multiple of
-    ``row_len`` with the dtype's max (pad keys sort to the tail and are
-    sliced off). Unsigned integer dtypes only; ``row_len`` must be a
-    power of two."""
-    if row_len <= 0 or row_len & (row_len - 1):
-        raise ValueError(f"row_len must be a power of two, got {row_len}")
-    (n,) = x.shape
-    if n <= row_len or n & (n - 1):
-        target = max(row_len, 1 << (n - 1).bit_length())
-        if target != n:
-            pad_val = jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype)
-            x = jnp.concatenate([x, jnp.full((target - n,), pad_val, x.dtype)])
-    m = x.shape[0]
-    if m <= row_len:
-        return jnp.sort(x)[:n]
-    v = jnp.sort(x.reshape(m // row_len, row_len), axis=1)
-    while v.shape[0] > 1:
-        # adjacent row pairs -> one bitonic row: ascending ++ descending
-        asc = v[0::2]
-        desc = jnp.flip(v[1::2], axis=1)
-        v = _bitonic_merge_rows(jnp.concatenate([asc, desc], axis=1))
-    return v[0, :n]
+    Returns ``(slab [P, capacity], counts [P], overflowed scalar
+    bool)``; semantics identical to :func:`pack_by_partition` (rows
+    padded with ``fill``; surplus clamped; caller retries a larger
+    capacity class on overflow).
+    """
+    if num_partitions & (num_partitions - 1):
+        raise ValueError("num_partitions must be a power of two")
+    n = sorted_keys.shape[0]
+    p = num_partitions
+    shift = key_bits - (p.bit_length() - 1)
+    # range edges: partition e owns keys in [e << shift, (e+1) << shift);
+    # computed as static Python ints (uint64 is unavailable under the
+    # default x64-disabled config, and e << shift fits the key dtype)
+    edges = jnp.asarray([e << shift for e in range(1, p)], sorted_keys.dtype)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.searchsorted(sorted_keys, edges).astype(jnp.int32)]
+    )
+    ends = jnp.concatenate([starts[1:], jnp.asarray([n], jnp.int32)])
+    counts = ends - starts
+    overflowed = jnp.any(counts > capacity)
+    # row e = keys[starts[e] : starts[e]+capacity]: one dynamic_slice per
+    # partition (contiguous, static size — never the slow gather path);
+    # pad the tail so a run near the end can't clamp-shift its window
+    padded = jnp.concatenate(
+        [sorted_keys, jnp.full((capacity,), fill, sorted_keys.dtype)]
+    )
+    rows = [
+        jax.lax.dynamic_slice(padded, (starts[e],), (capacity,))
+        for e in range(p)
+    ]
+    slab = jnp.stack(rows, axis=0)
+    valid = jnp.arange(capacity, dtype=jnp.int32)[None, :] < counts[:, None]
+    slab = jnp.where(valid, slab, jnp.asarray(fill, sorted_keys.dtype))
+    return slab, jnp.minimum(counts, capacity), overflowed
 
 
 def merge_received(
@@ -132,4 +170,4 @@ def merge_received(
     p, cap = slab.shape
     valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]
     flat = jnp.where(valid, slab, jnp.asarray(sentinel, slab.dtype)).reshape(-1)
-    return jnp.sort(flat), counts.sum()
+    return device_sort(flat), counts.sum()

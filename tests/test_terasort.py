@@ -11,7 +11,13 @@ import jax
 import jax.numpy as jnp
 
 from sparkrdma_tpu.models.terasort import TeraSorter
-from sparkrdma_tpu.ops.sort import merge_received, pack_by_partition, radix_partition
+from sparkrdma_tpu.ops.sort import (
+    device_sort,
+    merge_received,
+    pack_by_partition,
+    radix_partition,
+    split_sorted,
+)
 from sparkrdma_tpu.parallel.mesh import make_mesh
 
 
@@ -31,6 +37,48 @@ def test_pack_by_partition_layout_and_overflow():
     assert list(np.asarray(slab)[1, :3]) == [10, 30, 40]
     _, _, overflowed = pack_by_partition(vals, dest, 2, capacity=2, fill=0)
     assert bool(overflowed)
+
+
+def test_device_sort_matches_numpy():
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 1 << 32, size=20_000, dtype=np.uint32)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(device_sort)(jnp.asarray(keys))), np.sort(keys)
+    )
+
+
+def test_split_sorted_matches_pack_semantics():
+    """split_sorted on sorted keys == pack_by_partition row contents
+    (up to within-row order, which split_sorted additionally sorts)."""
+    rng = np.random.default_rng(4)
+    keys = rng.integers(0, 1 << 32, size=8192, dtype=np.uint32)
+    p, cap = 8, 2048
+    skeys = jnp.sort(jnp.asarray(keys))
+    slab, counts, overflowed = split_sorted(skeys, p, cap, 32, fill=0)
+    assert not bool(overflowed)
+    dest = radix_partition(jnp.asarray(keys), p)
+    pslab, pcounts, _ = pack_by_partition(jnp.asarray(keys), dest, p, cap, fill=0)
+    np.testing.assert_array_equal(np.asarray(counts), np.asarray(pcounts))
+    for e in range(p):
+        c = int(np.asarray(counts)[e])
+        np.testing.assert_array_equal(
+            np.asarray(slab)[e, :c], np.sort(np.asarray(pslab)[e, :c])
+        )
+        assert (np.asarray(slab)[e, c:] == 0).all()  # fill beyond count
+
+
+def test_split_sorted_overflow_and_edges():
+    # all keys in partition 0 -> overflow at small capacity
+    skeys = jnp.sort(jnp.asarray(np.arange(100, dtype=np.uint32)))
+    _, counts, overflowed = split_sorted(skeys, 4, 32, 32, fill=0)
+    assert bool(overflowed)
+    assert int(np.asarray(counts)[0]) == 32  # clamped
+    # exact range-edge keys land in the owning partition (half-open)
+    edge = jnp.asarray([0, 1 << 30, (1 << 30) + 1, 3 << 30], dtype=jnp.uint32)
+    slab, counts, overflowed = split_sorted(edge, 4, 4, 32, fill=0)
+    assert not bool(overflowed)
+    assert list(np.asarray(counts)) == [1, 2, 0, 1]
+    assert list(np.asarray(slab)[1, :2]) == [1 << 30, (1 << 30) + 1]
 
 
 def test_merge_received_masks_padding():
