@@ -68,6 +68,17 @@ class ProtectionDomain:
         with self._lock:
             self._regions.pop(mkey, None)
 
+    def region_length(self, mkey: int) -> int:
+        """Total byte length of a registered region (for local
+        consumers that want the class-spanning view, not just the
+        advertised valid prefix — see DeviceShuffleIO's local
+        short-circuit)."""
+        with self._lock:
+            region = self._regions.get(mkey)
+        if region is None:
+            raise RegionError(f"mkey {mkey} not registered in pd {self.pd_id}")
+        return len(region)
+
     def resolve(self, mkey: int, offset: int, length: int) -> memoryview:
         """Resolve (mkey, offset, length) → memory, bounds-checked.
 

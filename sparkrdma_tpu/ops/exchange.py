@@ -137,6 +137,17 @@ class ExchangeProgram:
         )
         return jax.jit(fn)
 
+    def program_for(self, rows: int, block: int, dtype) -> "jax.stages.Wrapped":
+        """The cached compile-once executable for a shape class — the
+        SVC handle (pre-serialized WR list) callers may embed inside
+        larger jitted programs (TeraSort steps, benches)."""
+        key = ("a2a", rows, (block,), jnp.dtype(dtype).name)
+        fn = self._all_to_all_cache.get(key)
+        if fn is None:
+            fn = self._build_all_to_all(rows, block, dtype)
+            self._all_to_all_cache[key] = fn
+        return fn
+
     def exchange(self, send, counts):
         """Dense exchange; returns (recv, recv_counts) with identical shapes.
 
@@ -144,11 +155,7 @@ class ExchangeProgram:
         shardable over the mesh; ``counts``: [E*rows_per_shard] int32.
         """
         rows = send.shape[0] // self.num_shards
-        key = ("a2a", rows, send.shape[1:], jnp.dtype(send.dtype).name)
-        fn = self._all_to_all_cache.get(key)
-        if fn is None:
-            fn = self._build_all_to_all(rows, send.shape[1], send.dtype)
-            self._all_to_all_cache[key] = fn
+        fn = self.program_for(rows, send.shape[1], send.dtype)
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         send = jax.device_put(send, sharding)
         counts = jax.device_put(counts, sharding)

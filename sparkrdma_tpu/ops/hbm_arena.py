@@ -111,31 +111,46 @@ class DeviceBuffer:
         return self
 
     def put_array(self, arr) -> "DeviceBuffer":
-        """Adopt a device-resident uint8 array as the slab contents."""
-        if arr.dtype != jnp.uint8 or arr.ndim != 1:
-            raise ValueError("slab contents must be 1-D uint8")
-        if arr.shape[0] > self.capacity:
+        """Adopt a device-resident 1-D array as the slab contents.
+
+        Any dtype is allowed (``length`` stays in BYTES): staging keys
+        as uint32 lets downstream programs consume the slab directly —
+        assembling words from a uint8 slab on-device costs a
+        [..., 4]-minor reshape whose TPU tiled layout pads 4 -> 128
+        (measured: a 32 GiB allocation for a 1 GiB merge input)."""
+        if arr.ndim != 1:
+            raise ValueError("slab contents must be 1-D")
+        if arr.nbytes > self.capacity:
             raise ValueError("array exceeds slab capacity")
         self.ensure_device()
-        self.length = arr.shape[0]
+        self.length = arr.nbytes
         old = self.array
-        if arr.shape[0] < self.capacity:
-            arr = jnp.zeros((self.capacity,), dtype=jnp.uint8).at[: arr.shape[0]].set(arr)
+        if arr.nbytes < self.capacity:
+            n = self.capacity // arr.dtype.itemsize
+            arr = jnp.zeros((n,), dtype=arr.dtype).at[: arr.shape[0]].set(arr)
         self.array = arr
         old.delete()
         self._manager._touch(self)
         return self
 
     def read(self, offset: int = 0, length: Optional[int] = None) -> bytes:
-        """Readback of ``[offset, offset+length)`` from either tier."""
+        """Readback of BYTES ``[offset, offset+length)`` from either
+        tier, regardless of the slab's staged dtype."""
         if length is None:
             length = self.length - offset
         if offset < 0 or length < 0 or offset + length > self.capacity:
             raise ValueError("read out of slab bounds")
         if self._host is not None:
-            return self._host[offset : offset + length].tobytes()
+            return self._host.view(np.uint8)[offset : offset + length].tobytes()
         self._manager._touch(self)
-        return np.asarray(self.array[offset : offset + length]).tobytes()
+        # slice on-device in whole elements (keeps the transfer small),
+        # trim to byte bounds host-side
+        k = np.dtype(self.array.dtype).itemsize
+        lo = offset // k
+        hi = -(-(offset + length) // k)
+        chunk = np.asarray(self.array[lo:hi]).view(np.uint8)
+        start = offset - lo * k
+        return chunk[start : start + length].tobytes()
 
     def free(self) -> None:
         self._manager.put(self)
@@ -293,18 +308,42 @@ class DeviceBufferManager:
         """Pool + stage in one step (host bytes -> registered HBM slab)."""
         return self.get(len(data)).stage(data)
 
-    def stage_view(self, view) -> DeviceBuffer:
+    def stage_view(self, view, valid_len: Optional[int] = None,
+                   dtype=np.uint8) -> DeviceBuffer:
         """Pool + stage from a buffer-protocol object WITHOUT the host
         round trip ``stage_bytes`` pays: the device transfer reads the
-        source memory directly (one DMA) and padding to the slab's
-        size class happens on-device, so a fetch's registered buffer
-        never materializes an intermediate ``bytes`` (SURVEY.md §7.3(3):
-        the copy count at the host<->HBM seam is the difference between
-        matching and missing the wire rate)."""
+        source memory directly (one DMA), and no pad program ever
+        compiles — the transfer is exactly one slab class long
+        (SURVEY.md §7.3(3): the copy count at the host<->HBM seam is
+        the difference between matching and missing the wire rate).
+
+        ``valid_len`` (default: the whole view) is the byte length of
+        the real contents. When the source is at least a slab class
+        long — always true for pooled registered buffers, whose
+        power-of-two classes match the device pool's — the tail past
+        ``valid_len`` rides along as this process's own pooled bytes
+        and is masked by ``length`` downstream; that removes the
+        per-(length, capacity) jitted pad `put_array` would otherwise
+        build (measured: each novel shape pair cost a multi-second
+        Mosaic compile in the fetch path).
+
+        ``dtype`` reinterprets the bytes host-side (free) so the slab
+        lands typed — e.g. uint32 keys a device merge consumes
+        directly (see ``put_array`` on why on-device byte->word
+        assembly is ruinous on TPU)."""
         src = np.frombuffer(view, dtype=np.uint8)
-        buf = self.get(src.nbytes)
-        arr = jax.device_put(src, buf.device)
+        n = src.nbytes if valid_len is None else valid_len
+        buf = self.get(n)
+        if src.nbytes >= buf.capacity:
+            arr = jax.device_put(src[: buf.capacity].view(dtype), buf.device)
+        else:
+            # short source (not from a pooled class): pad host-side —
+            # one memcpy, still compile-free
+            host = np.zeros((buf.capacity,), dtype=np.uint8)
+            host[: src.nbytes] = src
+            arr = jax.device_put(host.view(dtype), buf.device)
         buf = buf.put_array(arr)
+        buf.length = n
         # device_put may read the source asynchronously; callers recycle
         # the source buffer (a pooled registered region) immediately, so
         # the transfer must be complete before this returns

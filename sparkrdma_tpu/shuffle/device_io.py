@@ -22,7 +22,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
-from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+from sparkrdma_tpu.ops.hbm_arena import (
+    DeviceBuffer,
+    DeviceBufferManager,
+    _size_class,
+)
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
 from sparkrdma_tpu.transport import FnListener
 
@@ -106,7 +110,10 @@ class DeviceShuffleIO:
 
         Local blocks short-circuit from the publisher's own registered
         buffer (never looping through the network, SURVEY.md §5.1 #2).
-        Returns pid -> list of DeviceBuffers (caller frees)."""
+        ``dtype`` types the staged slabs (host-side reinterpret; see
+        ``DeviceBufferManager.stage_view``) so device consumers read
+        keys, not bytes. Returns pid -> list of DeviceBuffers (caller
+        frees)."""
         mgr = self._manager
         conf = mgr.conf
         if timeout_s is None:
@@ -170,11 +177,19 @@ class DeviceShuffleIO:
             for loc in locations:
                 if loc.manager_id.executor_id == my_id:
                     # local short-circuit straight from the registered
-                    # region — DMA'd directly, never copied to bytes
-                    view = mgr.node.pd.resolve(
-                        loc.block.mkey, loc.block.address, loc.block.length
+                    # region — DMA'd directly, never copied to bytes.
+                    # Resolve up to a full slab class past the block's
+                    # start (pooled regions span one, so this usually
+                    # covers it) to hit stage_view's compile- and
+                    # copy-free branch; only a region tail (mapped-file
+                    # chunk) falls back to the host-pad branch.
+                    pd = mgr.node.pd
+                    avail = (
+                        pd.region_length(loc.block.mkey) - loc.block.address
                     )
-                    dev = self._dev.stage_view(view)
+                    span = min(_size_class(loc.block.length), avail)
+                    view = pd.resolve(loc.block.mkey, loc.block.address, span)
+                    dev = self._dev.stage_view(view, loc.block.length, dtype)
                     out.setdefault(loc.partition_id, []).append(dev)
                     continue
                 reg = mgr.buffer_manager.get(loc.block.length)
@@ -187,11 +202,12 @@ class DeviceShuffleIO:
                     raise FetchFailedError(
                         loc.manager_id, shuffle_id, -1, loc.partition_id, str(err)
                     )
-                # registered buffer -> HBM directly (one DMA, on-device
-                # padding); the buffer returns to the pool only after
-                # the transfer, which device_put completes synchronously
+                # registered buffer -> HBM directly (one DMA, no pad
+                # program: the pooled source spans a full slab class);
+                # the buffer returns to the pool only after the
+                # transfer, which device_put completes synchronously
                 # for host sources
-                dev = self._dev.stage_view(reg.view[: loc.block.length])
+                dev = self._dev.stage_view(reg.view, loc.block.length, dtype)
                 mgr.buffer_manager.put(reg)  # pooled reuse, not a cold free
                 pending[i] = None
                 out.setdefault(loc.partition_id, []).append(dev)

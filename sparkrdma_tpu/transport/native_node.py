@@ -113,6 +113,15 @@ class NativeProtectionDomain:
         with self._lock:
             self._mirror.pop(mkey, None)
 
+    def region_length(self, mkey: int) -> int:
+        from sparkrdma_tpu.memory.registry import RegionError
+
+        with self._lock:
+            view = self._mirror.get(mkey)
+        if view is None:
+            raise RegionError(f"unknown mkey {mkey}")
+        return len(view)
+
     def resolve(self, mkey: int, offset: int, length: int) -> memoryview:
         from sparkrdma_tpu.memory.registry import RegionError
 

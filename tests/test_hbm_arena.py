@@ -32,6 +32,37 @@ def test_stage_read_roundtrip():
     mgr.stop()
 
 
+def test_stage_view_typed_u32():
+    """u32 staging: host-side reinterpret, byte-accurate readback, and
+    spill/restore that survive a non-uint8 slab dtype (the merge path
+    consumes keys directly — on-device byte->word assembly would pad
+    the [..., 4] minor dim 4->128 under TPU tiling)."""
+    import numpy as np
+
+    mgr = DeviceBufferManager()
+    keys = np.arange(7000, dtype=np.uint32)
+    buf = mgr.stage_view(memoryview(keys.view(np.uint8)), keys.nbytes,
+                         dtype=np.uint32)
+    assert buf.length == keys.nbytes
+    assert str(buf.array.dtype) == "uint32"
+    assert buf.array.shape[0] == buf.capacity // 4
+    assert np.array_equal(
+        np.frombuffer(buf.read(0, keys.nbytes), np.uint32), keys
+    )
+    # unaligned byte read off a typed slab
+    assert buf.read(2, 6) == keys.view(np.uint8)[2:8].tobytes()
+    # spill -> restore keeps contents and dtype
+    buf.spill_to_host()
+    assert buf.read(0, keys.nbytes) == keys.tobytes()
+    buf.ensure_device()
+    assert str(buf.array.dtype) == "uint32"
+    assert np.array_equal(
+        np.frombuffer(buf.read(0, keys.nbytes), np.uint32), keys
+    )
+    buf.free()
+    mgr.stop()
+
+
 def test_pool_reuse_same_class():
     mgr = DeviceBufferManager()
     a = mgr.get(20_000)

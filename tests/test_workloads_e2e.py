@@ -1,0 +1,32 @@
+"""The full-stack e2e TeraSort workload at CI scale.
+
+benchmarks/run_workloads.py's ``terasort_e2e`` is the round artifact's
+headline workload (host map sorts -> registered publish -> location
+RPC -> one-sided READ -> HBM staging -> device merge, verified by
+on-device sortedness + order-invariant checksums). Running it tiny
+here keeps the artifact path exercised by CI, not just by the round
+driver (the round-2 native breakage would have been caught by exactly
+this)."""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "run_workloads",
+    os.path.join(os.path.dirname(__file__), "..", "benchmarks", "run_workloads.py"),
+)
+run_workloads = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(run_workloads)
+
+
+def test_e2e_terasort_python_transport():
+    run_workloads.bench_e2e_terasort(0.002, "python", reducers=4, executors=2)
+    rec = run_workloads.RECORDS[-1]
+    assert rec["workload"] == "terasort_e2e"
+    assert rec["verified"].startswith("count+sum+xor+sorted")
+
+
+def test_e2e_terasort_native_transport():
+    run_workloads.bench_e2e_terasort(0.002, "native", reducers=4, executors=2)
+    rec = run_workloads.RECORDS[-1]
+    assert rec["transport"] == "native"
