@@ -12,6 +12,12 @@ same planes of this framework on one chip + one host:
   RdmaChannel.java:360-393 + RdmaMappedFile.java:135-209).
   ``vs_baseline`` divides by 12.5 GB/s, the 100 GbE wire-rate
   operating point the reference tuned against (BASELINE.md).
+  ``pread_roofline_gbps`` is the MACHINE's limit for this path —
+  raw single-core page-cache pread into the same rotating
+  destination set, measured in-process — so the headline is
+  interpretable: on this 1-core box the transport saturates it
+  (~4 GB/s ≈ 100% of roofline; a naive single-dst probe reads ~70%
+  high because the destination stays cache-resident).
 - ``native_read_streamed_gbps``: the same READ path when the region is
   anonymous (no file backing), so every byte moves through the socket
   streaming plane.
@@ -107,6 +113,27 @@ def bench_native_reads() -> dict:
                 one_round(mkey, label)
             return READ_TOTAL / (time.perf_counter() - t0) / 1e9
 
+        # machine roofline for the fast path: raw page-cache pread into
+        # the SAME rotating destination set (cache-honest: a single
+        # reused dst stays L3-resident and reads ~70% too fast)
+        import os
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(dir="/dev/shm") as f:
+            f.write(rng.integers(0, 256, READ_REGION, dtype=np.uint8).tobytes())
+            f.flush()
+            rfd = f.fileno()
+            for i in range(n_blocks):
+                os.preadv(rfd, [dsts[i]], i * READ_BLOCK)
+            t0 = time.perf_counter()
+            moved = 0
+            for _ in range(rounds):
+                for i in range(n_blocks):
+                    moved += os.preadv(rfd, [dsts[i]], i * READ_BLOCK)
+            out["pread_roofline_gbps"] = round(
+                moved / (time.perf_counter() - t0) / 1e9, 3
+            )
+
         # same-host fast path: shm-backed registered slab (pread plane)
         buf = TpuBuffer(srv.pd, READ_REGION, register=True)
         src = rng.integers(0, 256, size=READ_REGION, dtype=np.uint8)
@@ -129,18 +156,75 @@ def bench_native_reads() -> dict:
                               anon[READ_BLOCK: 2 * READ_BLOCK]):
             raise SystemExit("BENCH FAILED: streamed READ bytes differ")
         out["native_read_streamed_gbps"] = round(gbps, 3)
+
+        # this plane's machine limit: raw single-core loopback socket
+        # (8 MiB sends, rotating destination set, same rig)
+        out["socket_roofline_gbps"] = _socket_roofline()
     finally:
         cli.stop()
         srv.stop()
     return out
 
 
+def _socket_roofline() -> float:
+    """Raw single-core loopback TCP throughput at the bench's block
+    size — the streamed plane's machine limit on this rig. Moves the
+    same volume as the paths it calibrates (a short probe jitters
+    enough on a loaded 1-core rig to land under the plane it bounds)."""
+    import socket
+
+    from sparkrdma_tpu.transport.wire import read_into
+
+    block = READ_BLOCK
+    total = READ_TOTAL
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    src = np.random.default_rng(3).integers(
+        0, 256, block, dtype=np.uint8
+    ).tobytes()
+
+    def server():
+        c, _ = srv.accept()
+        c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        for _ in range(total // block):
+            c.sendall(src)
+        c.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+    cli.settimeout(120)
+    try:
+        dsts = [memoryview(bytearray(block)) for _ in range(8)]
+
+        read_into(cli, dsts[0])  # warm
+        t0 = time.perf_counter()
+        n = 0
+        for i in range(1, total // block):
+            read_into(cli, dsts[i % 8])
+            n += block
+        gbps = n / (time.perf_counter() - t0) / 1e9
+    finally:
+        cli.close()
+        srv.close()
+        t.join(10)
+    return round(gbps, 3)
+
+
 # ---------------------------------------------------------------------------
 # device plane: chained-jit differencing (see module docstring)
 # ---------------------------------------------------------------------------
 
-def _chained_ms(jax, jnp, step, x, k1, k2, reps=4):
-    """ms per step of ``step(state, i) -> state`` (state: device pytree)."""
+def _chained_ms(jax, jnp, step, x, k1, k2, reps=6):
+    """ms per step of ``step(state, i) -> state`` (state: device pytree).
+
+    Differences a k2-step chain against a k1-step chain to cancel
+    dispatch latency. Under rig-load spikes the difference can come
+    out non-positive; fall back to the k2 chain's per-step time —
+    dispatch-inclusive, so a conservative UNDER-estimate of
+    throughput — rather than ever reporting a negative rate."""
 
     @partial(jax.jit, static_argnums=(1,))
     def runk(v, k):
@@ -157,7 +241,12 @@ def _chained_ms(jax, jnp, step, x, k1, k2, reps=4):
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    return (timed(k2) - timed(k1)) / (k2 - k1) * 1e3
+    for _ in range(2):
+        t_hi = timed(k2)
+        delta = t_hi - timed(k1)
+        if delta > 0:
+            return delta / (k2 - k1) * 1e3
+    return t_hi / k2 * 1e3
 
 
 def bench_device(jax) -> dict:
@@ -247,7 +336,7 @@ def bench_device(jax) -> dict:
         r, rc = xfn(s_ ^ jnp.uint8(1), c_)  # xor defeats loop collapsing
         return (r, rc)
 
-    ems = _chained_ms(jax, jnp, ex_step, (slab, counts), 2, 18)
+    ems = _chained_ms(jax, jnp, ex_step, (slab, counts), 8, 72)
     out["exchange_loopback_gbps"] = round(block / (ems / 1e3) / 1e9, 3)
     return out
 

@@ -86,7 +86,13 @@ def bench_device_terasort(scale: float):
     report(
         "terasort_device", dt,
         keys=n, devices=len(jax.devices()),
-        gbps=round(n * 4 / dt / 1e9, 3),
+        e2e_gbps_incl_transfers=round(n * 4 / dt / 1e9, 3),
+        note=(
+            "wall time includes host->device and device->host of every "
+            "byte; on this rig those ride the axon tunnel (~15 MB/s "
+            "readback) and dominate — bench.py's device_sort_gbps is "
+            "the on-chip rate of the same step"
+        ),
     )
 
 
@@ -267,6 +273,9 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             t_merge += time.perf_counter() - t0
         phases["fetch_stage_s"] = t_fetch
         phases["device_merge_s"] = t_merge
+        # live observability counters (pool allocs, read-path split,
+        # fetch histograms, HBM budget/spills) into the artifact
+        metrics = reducer_io.metrics_snapshot()
     finally:
         for io in ios:
             io.stop()
@@ -283,6 +292,14 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         vs_host_sort=round(t_host / total, 3),
         compile_warm_s=round(phases_compile, 3),
         verified="count+sum+xor+sorted (on-device)",
+        metrics=metrics,
+        note=(
+            "single-host rig: fetch_stage/device_merge phases are "
+            "dominated by axon-tunnel dispatch+transfer latency, not "
+            "framework code (bench.py measures the planes in "
+            "isolation); the reference's 1.41x was multi-node where "
+            "shuffle crosses a real network"
+        ),
         **{k: round(v, 3) for k, v in phases.items()},
     )
 

@@ -227,6 +227,17 @@ class DeviceShuffleIO:
             raise
 
     # ------------------------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        """Manager counters + the device (HBM) pool's: allocation per
+        size class, live budget, and host-tier spill count."""
+        snap = self._manager.metrics_snapshot()
+        snap["hbm_pool_allocs_by_class"] = {
+            str(k): v for k, v in self._dev.stats().items()
+        }
+        snap["hbm_in_use_bytes"] = self._dev.in_use_bytes
+        snap["hbm_spill_count"] = self._dev.spill_count
+        return snap
+
     def unpublish(self, shuffle_id: int) -> None:
         """Release the registered buffers serving a shuffle's blocks."""
         with self._lock:

@@ -423,6 +423,33 @@ class TpuShuffleManager:
         assert self.node is not None
         return self.node.buffer_manager
 
+    def metrics_snapshot(self) -> dict:
+        """One live observability dict for this manager.
+
+        The reference scatters its observability across shutdown logs
+        (pool stats RdmaBufferManager.java:131-141, fetch histograms
+        RdmaShuffleReaderStats.scala:48-75) — here the same counters
+        are queryable mid-run so workload artifacts can record them
+        (benchmarks/run_workloads.py writes one per e2e run)."""
+        snap: dict = {
+            "executor_id": self.executor_id,
+            "is_driver": self.is_driver,
+        }
+        node = self.node
+        if node is not None:
+            snap["transport"] = type(node).__name__
+            snap["registered_pool_allocs_by_class"] = {
+                str(k): v for k, v in node.buffer_manager.stats().items()
+            }
+            rps = getattr(node, "read_path_stats", None)
+            if rps is not None:
+                fast, streamed = rps()
+                snap["reads_samehost_fast_path"] = fast
+                snap["reads_streamed"] = streamed
+        if self.reader_stats is not None:
+            snap["fetch_latency_histograms"] = self.reader_stats.snapshot()
+        return snap
+
     def stop(self) -> None:
         with self._lock:
             if self._stopped:

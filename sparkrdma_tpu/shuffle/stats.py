@@ -65,6 +65,16 @@ class ShuffleReaderStats:
                 self._per_remote[remote] = hist
         hist.add(latency_ms)
 
+    def snapshot(self) -> Dict[str, List[int]]:
+        """Live queryable form of what ``print_stats`` logs at stop:
+        remote endpoint -> bucket counts (last bucket = overflow)."""
+        with self._lock:
+            items = list(self._per_remote.items())
+        return {
+            f"{mid.executor_id}@{mid.host}:{mid.port}": hist.snapshot()
+            for mid, hist in items
+        }
+
     def print_stats(self) -> None:
         with self._lock:
             items = list(self._per_remote.items())

@@ -24,9 +24,21 @@ def test_e2e_terasort_python_transport():
     rec = run_workloads.RECORDS[-1]
     assert rec["workload"] == "terasort_e2e"
     assert rec["verified"].startswith("count+sum+xor+sorted")
+    # observability rides in the artifact record
+    m = rec["metrics"]
+    assert m["registered_pool_allocs_by_class"]
+    assert m["hbm_pool_allocs_by_class"]
+    assert m["hbm_spill_count"] == 0
 
 
 def test_e2e_terasort_native_transport():
     run_workloads.bench_e2e_terasort(0.002, "native", reducers=4, executors=2)
     rec = run_workloads.RECORDS[-1]
     assert rec["transport"] == "native"
+    m = rec["metrics"]
+    # the reducer pulls half its blocks from the co-located peer
+    # executor over the native plane: every one of those READs must
+    # have taken the same-host pread fast path
+    assert m["transport"] == "NativeTpuNode"
+    assert m["reads_samehost_fast_path"] > 0
+    assert m["reads_streamed"] == 0
