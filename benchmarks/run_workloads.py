@@ -248,6 +248,9 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             bufs = got[r]
             t_fetch += time.perf_counter() - t0
             t0 = time.perf_counter()
+            # no-op unless HBM pressure spilled some: restores the set
+            # without ever victimizing its own members
+            reducer_io.device_buffers.ensure_device_all(bufs)
             cap = max(b.array.shape[0] for b in bufs)
             arrs = tuple(
                 b.array

@@ -87,11 +87,15 @@ class DeviceBuffer:
         self.array = None
         self._manager._on_spill(self)
 
-    def ensure_device(self) -> "DeviceBuffer":
-        """Restore a spilled buffer to HBM (may spill others to fit)."""
+    def ensure_device(self, _pinned=None) -> "DeviceBuffer":
+        """Restore a spilled buffer to HBM (may spill others to fit).
+
+        ``_pinned``: handles that must NOT be chosen as spill victims —
+        used by ``DeviceBufferManager.ensure_device_all`` so restoring
+        one buffer of a held working set never re-spills another."""
         if self._host is None:
             return self
-        self._manager._reserve_for_restore(self)
+        self._manager._reserve_for_restore(self, _pinned)
         host, self._host = self._host, None
         self.array = jax.device_put(host, self._manager.device)
         return self
@@ -207,24 +211,25 @@ class DeviceBufferManager:
             self._in_use_bytes -= buf.capacity
             self._spill_count += 1
 
-    def _pick_spill_victim(self, exclude_handle: int) -> Optional[DeviceBuffer]:
+    def _pick_spill_victim(self, pinned) -> Optional[DeviceBuffer]:
         with self._lock:
             candidates = [
                 b
                 for b in self._handles.values()
-                if b.handle != exclude_handle and not b.spilled and b.array is not None
+                if b.handle not in pinned and not b.spilled and b.array is not None
             ]
             if not candidates:
                 return None
             return min(candidates, key=lambda b: b.last_use)
 
-    def _make_room(self, cls: int, exclude_handle: int = -1) -> None:
-        """Spill LRU device-resident buffers until ``cls`` bytes fit."""
+    def _make_room(self, cls: int, pinned=frozenset()) -> None:
+        """Spill LRU device-resident buffers (never a ``pinned`` handle)
+        until ``cls`` bytes fit."""
         while True:
             with self._lock:
                 if not self.max_bytes or self._in_use_bytes + cls <= self.max_bytes:
                     return
-            victim = self._pick_spill_victim(exclude_handle)
+            victim = self._pick_spill_victim(pinned)
             if victim is None:
                 with self._lock:
                     in_use = self._in_use_bytes
@@ -234,12 +239,33 @@ class DeviceBufferManager:
                 )
             victim.spill_to_host()
 
-    def _reserve_for_restore(self, buf: DeviceBuffer) -> None:
-        self._make_room(buf.capacity, exclude_handle=buf.handle)
+    def _reserve_for_restore(self, buf: DeviceBuffer, pinned=None) -> None:
+        pins = set(pinned) if pinned else set()
+        pins.add(buf.handle)
+        self._make_room(buf.capacity, pins)
         with self._lock:
             self._in_use_bytes += buf.capacity
             self._use_clock += 1
             buf.last_use = self._use_clock
+
+    def ensure_device_all(self, bufs) -> None:
+        """Restore a WORKING SET to HBM atomically with respect to
+        spilling: no member is ever picked as a victim to make room
+        for another, so after return every buffer in ``bufs`` is
+        device-resident (consumers may touch ``.array`` directly).
+        Raises MemoryError if the set itself cannot fit the budget —
+        loud, instead of silently thrash-spilling the set against
+        itself (which would leave some ``.array`` None)."""
+        handles = {b.handle for b in bufs}
+        if self.max_bytes:
+            need = sum(b.capacity for b in bufs)
+            if need > self.max_bytes:
+                raise MemoryError(
+                    f"working set of {need}B cannot fit HBM budget "
+                    f"{self.max_bytes}B; consume in smaller batches"
+                )
+        for b in bufs:
+            b.ensure_device(_pinned=handles)
 
     def get(self, nbytes: int) -> DeviceBuffer:
         """Allocate (or reuse) a slab whose class covers ``nbytes``.
@@ -262,7 +288,7 @@ class DeviceBufferManager:
         if pooled is not None:
             # the pooled slab re-enters the budget: spill LRU others if
             # that pushed us over the cap
-            self._make_room(0, exclude_handle=pooled.handle)
+            self._make_room(0, {pooled.handle})
             return pooled
         self._make_room(cls)
         with self._lock:

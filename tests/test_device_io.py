@@ -59,6 +59,59 @@ def test_device_block_shuffle_roundtrip(cluster):
         io1.stop()
 
 
+def test_fetch_under_hbm_budget_pressure_spills_and_survives():
+    """A tight ``hbm.maxBytes`` forces staged blocks to spill to the
+    host tier DURING a fetch; held buffers stay readable (transparent
+    host-tier read), restore on demand, and the budget never exceeds
+    the cap. This drives SURVEY §7.3(4)'s tiered HBM->host store
+    through the real publish/fetch stack rather than the pool alone."""
+    conf = TpuShuffleConf({"tpu.shuffle.hbm.maxBytes": str(64 * 1024)})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="sp-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="sp-1")
+    parts = 6
+    handle = BaseShuffleHandle(
+        shuffle_id=9, num_maps=2, partitioner=HashPartitioner(parts)
+    )
+    driver.register_shuffle(handle)
+    io0, io1 = DeviceShuffleIO(ex0), DeviceShuffleIO(ex1)
+    rng = np.random.default_rng(5)
+    # 12 blocks x 16 KiB class = 192 KiB of staging demand vs a 64 KiB cap
+    data = {
+        (m, p): rng.integers(0, 256, 16 * 1024 - 128, dtype=np.uint8)
+        for m in range(2)
+        for p in range(parts)
+    }
+    try:
+        io0.publish_device_blocks(9, {p: data[(0, p)] for p in range(parts)})
+        io1.publish_device_blocks(9, {p: data[(1, p)] for p in range(parts)})
+        held = io0.fetch_device_blocks(9, 0, parts, timeout_s=60)
+        pool = io0.device_buffers
+        assert pool.spill_count > 0, "cap of 4 slabs never spilled"
+        assert pool.in_use_bytes <= 64 * 1024
+        spilled = [b for bufs in held.values() for b in bufs if b.spilled]
+        assert spilled, "no held buffer ended up on the host tier"
+        # every block byte-exact, whichever tier it lives on
+        for p, bufs in held.items():
+            got = sorted(b.read(0, b.length) for b in bufs)
+            want = sorted(data[(m, p)].tobytes() for m in range(2))
+            assert got == want, f"partition {p} bytes differ under spill"
+        # explicit restore works and respects the cap by evicting others
+        spilled[0].ensure_device()
+        assert not spilled[0].spilled
+        assert pool.in_use_bytes <= 64 * 1024
+        for bufs in held.values():
+            for b in bufs:
+                b.free()
+        assert pool.in_use_bytes == 0
+    finally:
+        io0.stop()
+        io1.stop()
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
 def test_unpublish_releases_registered_buffers(cluster):
     conf, driver, ex0, ex1 = cluster
     handle = BaseShuffleHandle(shuffle_id=2, num_maps=1, partitioner=HashPartitioner(1))

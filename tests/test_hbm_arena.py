@@ -63,6 +63,27 @@ def test_stage_view_typed_u32():
     mgr.stop()
 
 
+def test_ensure_device_all_never_victimizes_the_set():
+    """Restoring a held working set must not thrash: making room for
+    one member may never spill another (b.array would be None under a
+    direct consumer). A set larger than the budget fails loudly."""
+    budget = 4 * MIN_BLOCK_SIZE
+    mgr = DeviceBufferManager(max_bytes=budget)
+    bufs = [mgr.stage_bytes(bytes([i]) * 100) for i in range(8)]  # spills
+    assert mgr.spill_count >= 4
+    held = bufs[:4]  # exactly fits the budget
+    mgr.ensure_device_all(held)
+    assert all(not b.spilled and b.array is not None for b in held)
+    assert mgr.in_use_bytes <= budget
+    # every OTHER buffer got pushed out, never a set member
+    assert all(b.spilled for b in bufs[4:])
+    with pytest.raises(MemoryError):
+        mgr.ensure_device_all(bufs[:5])  # 5 slabs > 4-slab budget
+    for b in bufs:
+        b.free()
+    mgr.stop()
+
+
 def test_pool_reuse_same_class():
     mgr = DeviceBufferManager()
     a = mgr.get(20_000)
