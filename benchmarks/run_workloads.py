@@ -248,18 +248,21 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             bufs = got[r]
             t_fetch += time.perf_counter() - t0
             t0 = time.perf_counter()
-            # no-op unless HBM pressure spilled some: restores the set
-            # without ever victimizing its own members
-            reducer_io.device_buffers.ensure_device_all(bufs)
-            cap = max(b.array.shape[0] for b in bufs)
-            arrs = tuple(
-                b.array
-                if b.array.shape[0] == cap
-                else jnp.zeros((cap,), jnp.uint32).at[: b.array.shape[0]].set(b.array)
-                for b in bufs
-            )
-            counts = jnp.asarray([b.length // 4 for b in bufs], jnp.int32)
-            merged, packed = merge(arrs, counts)
+            # pin the set device-resident across the direct .array
+            # access (no-op unless HBM pressure spilled some; members
+            # are never victims while pinned)
+            with reducer_io.device_buffers.pinned_on_device(bufs):
+                cap = max(b.array.shape[0] for b in bufs)
+                arrs = tuple(
+                    b.array
+                    if b.array.shape[0] == cap
+                    else jnp.zeros((cap,), jnp.uint32)
+                    .at[: b.array.shape[0]]
+                    .set(b.array)
+                    for b in bufs
+                )
+                counts = jnp.asarray([b.length // 4 for b in bufs], jnp.int32)
+                merged, packed = merge(arrs, counts)
             # ONE readback: [count, sum, xor, sorted]
             t, csum, cxor, ok = (int(x) for x in np.asarray(packed))
             if t != exp_cnt[r]:

@@ -24,6 +24,7 @@ RdmaShuffleBlockResolver.scala:38-47) via ``hbm.maxBytes``.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from typing import Dict, List, Optional
@@ -87,15 +88,13 @@ class DeviceBuffer:
         self.array = None
         self._manager._on_spill(self)
 
-    def ensure_device(self, _pinned=None) -> "DeviceBuffer":
-        """Restore a spilled buffer to HBM (may spill others to fit).
-
-        ``_pinned``: handles that must NOT be chosen as spill victims —
-        used by ``DeviceBufferManager.ensure_device_all`` so restoring
-        one buffer of a held working set never re-spills another."""
+    def ensure_device(self) -> "DeviceBuffer":
+        """Restore a spilled buffer to HBM (may spill others to fit;
+        never a buffer pinned via
+        ``DeviceBufferManager.pinned_on_device``)."""
         if self._host is None:
             return self
-        self._manager._reserve_for_restore(self, _pinned)
+        self._manager._reserve_for_restore(self)
         host, self._host = self._host, None
         self.array = jax.device_put(host, self._manager.device)
         return self
@@ -189,6 +188,7 @@ class DeviceBufferManager:
         self._in_use_bytes = 0
         self._use_clock = 0
         self._spill_count = 0
+        self._pins: Dict[int, int] = {}  # handle -> pin refcount
         self._lock = threading.Lock()
         self._stopped = False
         # optional warm-up (reference maxAggPrealloc, RdmaBufferManager.java:84-91)
@@ -216,7 +216,10 @@ class DeviceBufferManager:
             candidates = [
                 b
                 for b in self._handles.values()
-                if b.handle not in pinned and not b.spilled and b.array is not None
+                if b.handle not in pinned
+                and b.handle not in self._pins
+                and not b.spilled
+                and b.array is not None
             ]
             if not candidates:
                 return None
@@ -239,24 +242,30 @@ class DeviceBufferManager:
                 )
             victim.spill_to_host()
 
-    def _reserve_for_restore(self, buf: DeviceBuffer, pinned=None) -> None:
-        pins = set(pinned) if pinned else set()
-        pins.add(buf.handle)
-        self._make_room(buf.capacity, pins)
+    def _reserve_for_restore(self, buf: DeviceBuffer) -> None:
+        self._make_room(buf.capacity, {buf.handle})
         with self._lock:
             self._in_use_bytes += buf.capacity
             self._use_clock += 1
             buf.last_use = self._use_clock
 
-    def ensure_device_all(self, bufs) -> None:
-        """Restore a WORKING SET to HBM atomically with respect to
-        spilling: no member is ever picked as a victim to make room
-        for another, so after return every buffer in ``bufs`` is
-        device-resident (consumers may touch ``.array`` directly).
-        Raises MemoryError if the set itself cannot fit the budget —
-        loud, instead of silently thrash-spilling the set against
+    @contextlib.contextmanager
+    def pinned_on_device(self, bufs):
+        """Context manager: pin a WORKING SET device-resident.
+
+        Inside the ``with`` body every buffer in ``bufs`` is
+        device-resident and can never be picked as a spill victim —
+        not while restoring other members, and not by CONCURRENT pool
+        operations on other threads (pins are refcounted manager
+        state, not a call-local exclude list). Direct ``.array``
+        access is therefore safe exactly for the duration of the
+        block, and only there: on exit the pins drop and any later
+        pool op may spill the set again.
+
+        Raises MemoryError up front if the set itself cannot fit the
+        budget — loud, instead of thrash-spilling the set against
         itself (which would leave some ``.array`` None)."""
-        handles = {b.handle for b in bufs}
+        bufs = list(bufs)
         if self.max_bytes:
             need = sum(b.capacity for b in bufs)
             if need > self.max_bytes:
@@ -264,8 +273,33 @@ class DeviceBufferManager:
                     f"working set of {need}B cannot fit HBM budget "
                     f"{self.max_bytes}B; consume in smaller batches"
                 )
-        for b in bufs:
-            b.ensure_device(_pinned=handles)
+        handles = [b.handle for b in bufs]
+        with self._lock:
+            for h in handles:
+                self._pins[h] = self._pins.get(h, 0) + 1
+        try:
+            for b in bufs:
+                b.ensure_device()
+                # freshen EVERY member: a long-resident member must not
+                # linger as global LRU once the pins drop
+                self._touch(b)
+            yield
+        finally:
+            with self._lock:
+                for h in handles:
+                    c = self._pins.get(h, 0) - 1
+                    if c > 0:
+                        self._pins[h] = c
+                    else:
+                        self._pins.pop(h, None)
+
+    def ensure_device_all(self, bufs) -> None:
+        """Restore a working set to HBM without the set victimizing
+        itself. NOTE: protection ends when this returns — consumers
+        that touch ``.array`` directly should hold
+        ``pinned_on_device(bufs)`` across the access instead."""
+        with self.pinned_on_device(bufs):
+            pass
 
     def get(self, nbytes: int) -> DeviceBuffer:
         """Allocate (or reuse) a slab whose class covers ``nbytes``.
@@ -310,6 +344,9 @@ class DeviceBufferManager:
         with self._lock:
             if self._handles.pop(buf.handle, None) is None:
                 return  # double-free tolerated, like onFailure reentry
+            # freeing while pinned is a caller bug; don't let the stale
+            # pin shield a recycled slab from eviction forever
+            self._pins.pop(buf.handle, None)
             if buf.spilled:
                 # spilled slabs released their device budget already and
                 # have no device array to pool — just drop the host copy

@@ -1,0 +1,109 @@
+"""Device-block shuffle across real OS processes.
+
+The reference's deployment unit is one endpoint per executor JVM
+(RdmaNode per process); the in-process DeviceShuffleIO tests share a
+process. Here a child process publishes device blocks into its own
+registered memory and the parent's executor pulls them with one-sided
+READs over real TCP and stages them into its own device pool — the
+full cross-process path the driver's dryrun approximates with
+threads.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.native.transport_lib import available as native_available
+from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+SHUFFLE_ID = 31
+PARTS = 3
+
+
+def _pattern(pid: int) -> np.ndarray:
+    rng = np.random.default_rng(1000 + pid)
+    return rng.integers(0, 256, 3000 + 700 * pid, dtype=np.uint8)
+
+
+def _publisher_main(conf_dict, q_out, q_in):
+    # child owns its own JAX runtime on CPU (the env var must be set
+    # before import; see tests/conftest.py)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    conf = TpuShuffleConf(conf_dict)
+    ex = TpuShuffleManager(conf, is_driver=False, executor_id="proc-pub")
+    io = DeviceShuffleIO(ex)
+    try:
+        io.publish_device_blocks(
+            SHUFFLE_ID, {p: _pattern(p) for p in range(PARTS)}
+        )
+        q_out.put("published")
+        # keep serving one-sided READs until the parent is done
+        assert q_in.get(timeout=120) == "stop"
+    finally:
+        io.stop()
+        ex.stop()
+
+
+@pytest.mark.parametrize(
+    "transport",
+    ["python", pytest.param("native", marks=pytest.mark.skipif(
+        not native_available(), reason="native transport unavailable"))],
+)
+def test_cross_process_device_block_shuffle(transport):
+    conf = TpuShuffleConf({"tpu.shuffle.transport": transport})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    handle = BaseShuffleHandle(
+        shuffle_id=SHUFFLE_ID, num_maps=1, partitioner=HashPartitioner(PARTS)
+    )
+    driver.register_shuffle(handle)
+
+    ctx = mp.get_context("spawn")
+    q_out, q_in = ctx.Queue(), ctx.Queue()
+    child_conf = {
+        "tpu.shuffle.transport": transport,
+        "tpu.shuffle.driverPort": str(driver.node.port),
+    }
+    child = ctx.Process(
+        target=_publisher_main, args=(child_conf, q_out, q_in), daemon=True
+    )
+    child.start()
+    reader = TpuShuffleManager(
+        TpuShuffleConf(dict(child_conf)), is_driver=False,
+        executor_id="proc-read",
+    )
+    io = DeviceShuffleIO(reader)
+    try:
+        assert q_out.get(timeout=120) == "published"
+        got = io.fetch_device_blocks(SHUFFLE_ID, 0, PARTS, timeout_s=60)
+        assert set(got) == set(range(PARTS))
+        for p in range(PARTS):
+            (buf,) = got[p]
+            want = _pattern(p)
+            assert buf.length == want.nbytes
+            assert buf.read(0, buf.length) == want.tobytes(), (
+                f"partition {p} bytes differ across processes"
+            )
+            buf.free()
+        if transport == "native":
+            # co-located processes: every READ must ride the same-host
+            # pread fast path, zero streamed
+            m = io.metrics_snapshot()
+            assert m["reads_samehost_fast_path"] == PARTS
+            assert m["reads_streamed"] == 0
+    finally:
+        q_in.put("stop")
+        io.stop()
+        reader.stop()
+        child.join(timeout=30)
+        if child.is_alive():
+            child.terminate()
+        driver.stop()

@@ -63,22 +63,39 @@ def test_stage_view_typed_u32():
     mgr.stop()
 
 
-def test_ensure_device_all_never_victimizes_the_set():
+def test_pinned_working_set_never_victimized():
     """Restoring a held working set must not thrash: making room for
     one member may never spill another (b.array would be None under a
-    direct consumer). A set larger than the budget fails loudly."""
+    direct consumer) — and while the pin is held, OTHER pool traffic
+    can't victimize the set either, even a long-resident member that
+    would otherwise be the global LRU. A set larger than the budget
+    fails loudly."""
     budget = 4 * MIN_BLOCK_SIZE
     mgr = DeviceBufferManager(max_bytes=budget)
     bufs = [mgr.stage_bytes(bytes([i]) * 100) for i in range(8)]  # spills
     assert mgr.spill_count >= 4
     held = bufs[:4]  # exactly fits the budget
-    mgr.ensure_device_all(held)
-    assert all(not b.spilled and b.array is not None for b in held)
-    assert mgr.in_use_bytes <= budget
-    # every OTHER buffer got pushed out, never a set member
-    assert all(b.spilled for b in bufs[4:])
+    with mgr.pinned_on_device(held):
+        assert all(not b.spilled and b.array is not None for b in held)
+        assert mgr.in_use_bytes <= budget
+        # every OTHER buffer got pushed out, never a set member
+        assert all(b.spilled for b in bufs[4:])
+        # concurrent-traffic shape: with the whole budget pinned, new
+        # demand has nothing to evict and must fail loudly — never
+        # silently spill a pinned member
+        with pytest.raises(MemoryError):
+            mgr.stage_bytes(b"x" * 100)
+        assert all(not b.spilled for b in held)
+    # pins dropped: the same demand now evicts an (ex-)member fine
+    extra = mgr.stage_bytes(b"x" * 100)
+    assert sum(b.spilled for b in bufs[:4]) == 1
+    extra.free()
     with pytest.raises(MemoryError):
-        mgr.ensure_device_all(bufs[:5])  # 5 slabs > 4-slab budget
+        with mgr.pinned_on_device(bufs[:5]):  # 5 slabs > 4-slab budget
+            pass
+    # ensure_device_all remains as the non-holding convenience form
+    mgr.ensure_device_all(held)
+    assert all(not b.spilled for b in held)
     for b in bufs:
         b.free()
     mgr.stop()
