@@ -26,7 +26,10 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
+import tempfile
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -49,15 +52,16 @@ class DeviceBuffer:
 
     ``length`` is the caller-requested byte length; ``capacity`` the
     size-class slab length actually resident. ``array`` always has
-    shape [capacity] dtype uint8 while device-resident; under HBM
-    budget pressure a buffer may be **spilled** to host RAM (the
-    HBM -> host tier of the tiered shuffle store, SURVEY.md §7.3-4)
-    and transparently restored on next device use.
+    shape [capacity] while device-resident; under budget pressure a
+    buffer descends the tiered store of SURVEY.md §7.3(4) —
+    HBM -> host RAM -> disk — and transparently climbs back on next
+    device use. A shuffle far larger than HBM (the reference's 175 GB
+    bar vs 16 GiB/chip) therefore degrades in steps, never OOMs.
     """
 
     __slots__ = (
         "handle", "capacity", "length", "array", "_manager", "_host",
-        "last_use",
+        "_disk", "_tier_lock", "last_use",
     )
 
     def __init__(self, handle: int, capacity: int, array, manager):
@@ -66,12 +70,28 @@ class DeviceBuffer:
         self.length = 0
         self.array = array
         self._manager = manager
-        self._host: Optional[np.ndarray] = None  # set while spilled
+        self._host: Optional[np.ndarray] = None  # set while in host tier
+        self._disk = None  # (path, dtype_str, count) while in disk tier
+        # serializes TIER MOVES of this buffer (manager-initiated
+        # cascade victims race caller-initiated restores/frees).
+        # Ordering rules that keep this deadlock-free:
+        #  - buffer lock OUTER, manager._lock inner;
+        #  - a thread holds at most one UNPINNED buffer's lock, and
+        #    only for a self-contained move (no other buffer locks
+        #    taken inside);
+        #  - cascades run with NO buffer lock held;
+        #  - victim picks (the only cross-thread acquisition) never
+        #    target pinned buffers, and every climber pins itself.
+        self._tier_lock = threading.Lock()
         self.last_use = 0
 
     @property
     def spilled(self) -> bool:
-        return self._host is not None
+        return self._host is not None or self._disk is not None
+
+    @property
+    def on_disk(self) -> bool:
+        return self._disk is not None
 
     @property
     def device(self):
@@ -80,37 +100,98 @@ class DeviceBuffer:
         return self._manager.device
 
     def spill_to_host(self) -> None:
-        """HBM -> host RAM; releases device budget, keeps the handle."""
-        if self._host is not None:
-            return
-        self._host = np.asarray(self.array)
-        self.array.delete()
-        self.array = None
-        self._manager._on_spill(self)
+        """HBM -> host RAM; releases device budget, keeps the handle.
+        May cascade another buffer host -> disk under the host cap
+        (cascade runs after this buffer's lock is released)."""
+        with self._tier_lock:
+            if self.array is None:
+                return  # raced: someone else already moved it
+            self._host = np.asarray(self.array)
+            self.array.delete()
+            self.array = None
+            self._manager._on_spill(self)
+        self._manager._cascade_host_tier()
 
-    def ensure_device(self) -> "DeviceBuffer":
-        """Restore a spilled buffer to HBM (may spill others to fit;
-        never a buffer pinned via
-        ``DeviceBufferManager.pinned_on_device``)."""
-        if self._host is None:
-            return self
+    def spill_to_disk(self) -> None:
+        """Host RAM -> disk; releases host budget, keeps the handle.
+        Acts only on a host-tier resident (cascade victims); a raced
+        buffer that climbed away in the meantime is left alone."""
+        with self._tier_lock:
+            if self._host is None:
+                return
+            path = self._manager._disk_path(self.handle)
+            self._host.tofile(path)
+            self._disk = (path, str(self._host.dtype), self._host.shape[0])
+            self._host = None
+            self._manager._on_disk_spill(self)
+
+    def _ensure_host_locked(self) -> None:
+        """Disk -> host RAM (the climb's first step; tier lock held).
+        Budget is rolled back if the spill file cannot be read, so a
+        failed climb never inflates the host tier forever."""
+        if self._disk is None:
+            return
+        path, dtype_str, count = self._disk
+        self._manager._reserve_host(self)
+        try:
+            host = np.fromfile(path, dtype=np.dtype(dtype_str), count=count)
+            if host.shape[0] != count:
+                raise IOError(f"spill file truncated: {path}")
+        except BaseException:
+            self._manager._unreserve_host(self)
+            raise
+        os.unlink(path)
+        self._host = host
+        self._disk = None
+
+    def _climb_locked(self) -> None:
+        """To device residency; tier lock held, self pinned."""
+        if self.array is not None:
+            return
+        self._ensure_host_locked()
         self._manager._reserve_for_restore(self)
         host, self._host = self._host, None
         self.array = jax.device_put(host, self._manager.device)
+
+    def ensure_device(self) -> "DeviceBuffer":
+        """Restore a spilled buffer to HBM from whichever tier holds it
+        (may spill others to fit; never a buffer pinned via
+        ``DeviceBufferManager.pinned_on_device``). The buffer pins
+        ITSELF for the climb: the room-making its restore triggers
+        (device victims spilling to host, host cascade to disk) must
+        never pick the climber mid-ascent."""
+        if self.array is not None:
+            return self
+        m = self._manager
+        m._pin(self.handle)
+        try:
+            with self._tier_lock:
+                self._climb_locked()
+        finally:
+            m._unpin(self.handle)
         return self
 
     def stage(self, data: bytes) -> "DeviceBuffer":
-        """Host -> HBM: replace the slab contents (pads to capacity)."""
+        """Host -> HBM: replace the slab contents (pads to capacity).
+        Pinned + tier-locked: a concurrent spill can neither delete
+        the array mid-swap nor demote the slab while its budget is
+        accounted device-resident."""
         if len(data) > self.capacity:
             raise ValueError(f"{len(data)}B exceeds slab capacity {self.capacity}B")
-        self.ensure_device()
-        host = np.zeros((self.capacity,), dtype=np.uint8)
-        host[: len(data)] = np.frombuffer(data, dtype=np.uint8)
-        old = self.array
-        self.array = jax.device_put(host, self.device)
-        old.delete()
-        self.length = len(data)
-        self._manager._touch(self)
+        m = self._manager
+        m._pin(self.handle)
+        try:
+            with self._tier_lock:
+                self._climb_locked()
+                host = np.zeros((self.capacity,), dtype=np.uint8)
+                host[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+                old = self.array
+                self.array = jax.device_put(host, self.device)
+                old.delete()
+                self.length = len(data)
+        finally:
+            m._unpin(self.handle)
+        m._touch(self)
         return self
 
     def put_array(self, arr) -> "DeviceBuffer":
@@ -125,35 +206,51 @@ class DeviceBuffer:
             raise ValueError("slab contents must be 1-D")
         if arr.nbytes > self.capacity:
             raise ValueError("array exceeds slab capacity")
-        self.ensure_device()
-        self.length = arr.nbytes
-        old = self.array
-        if arr.nbytes < self.capacity:
-            n = self.capacity // arr.dtype.itemsize
-            arr = jnp.zeros((n,), dtype=arr.dtype).at[: arr.shape[0]].set(arr)
-        self.array = arr
-        old.delete()
-        self._manager._touch(self)
+        m = self._manager
+        m._pin(self.handle)
+        try:
+            with self._tier_lock:
+                self._climb_locked()
+                self.length = arr.nbytes
+                old = self.array
+                if arr.nbytes < self.capacity:
+                    n = self.capacity // arr.dtype.itemsize
+                    arr = jnp.zeros((n,), dtype=arr.dtype).at[: arr.shape[0]].set(arr)
+                self.array = arr
+                old.delete()
+        finally:
+            m._unpin(self.handle)
+        m._touch(self)
         return self
 
     def read(self, offset: int = 0, length: Optional[int] = None) -> bytes:
-        """Readback of BYTES ``[offset, offset+length)`` from either
-        tier, regardless of the slab's staged dtype."""
+        """Readback of BYTES ``[offset, offset+length)`` from whichever
+        tier holds the slab, regardless of the staged dtype. Tier-locked
+        so a concurrent spill cannot move (or delete) the bytes between
+        the tier check and the copy."""
         if length is None:
             length = self.length - offset
         if offset < 0 or length < 0 or offset + length > self.capacity:
             raise ValueError("read out of slab bounds")
-        if self._host is not None:
-            return self._host.view(np.uint8)[offset : offset + length].tobytes()
-        self._manager._touch(self)
-        # slice on-device in whole elements (keeps the transfer small),
-        # trim to byte bounds host-side
-        k = np.dtype(self.array.dtype).itemsize
-        lo = offset // k
-        hi = -(-(offset + length) // k)
-        chunk = np.asarray(self.array[lo:hi]).view(np.uint8)
-        start = offset - lo * k
-        return chunk[start : start + length].tobytes()
+        with self._tier_lock:
+            if self._disk is not None:
+                path, dtype_str, count = self._disk
+                mm = np.memmap(path, dtype=np.dtype(dtype_str), mode="r",
+                               shape=(count,))
+                return mm.view(np.uint8)[offset : offset + length].tobytes()
+            if self._host is not None:
+                return self._host.view(np.uint8)[
+                    offset : offset + length
+                ].tobytes()
+            self._manager._touch(self)
+            # slice on-device in whole elements (keeps the transfer
+            # small), trim to byte bounds host-side
+            k = np.dtype(self.array.dtype).itemsize
+            lo = offset // k
+            hi = -(-(offset + length) // k)
+            chunk = np.asarray(self.array[lo:hi]).view(np.uint8)
+            start = offset - lo * k
+            return chunk[start : start + length].tobytes()
 
     def free(self) -> None:
         self._manager.put(self)
@@ -177,18 +274,31 @@ class DeviceBufferManager:
     """Size-classed pool of HBM slabs for one device."""
 
     def __init__(self, device=None, max_bytes: int = 0, prealloc: int = 0,
-                 prealloc_size: int = 0):
+                 prealloc_size: int = 0, max_host_bytes: int = 0,
+                 spill_dir: Optional[str] = None):
         if device is None:
             device = jax.devices()[0]
         self.device = device
         self.max_bytes = max_bytes  # 0 = unbounded
+        # host-RAM tier cap; overflow cascades to disk (§7.3(4) tier 3)
+        self.max_host_bytes = max_host_bytes
+        self._spill_dir = spill_dir
         self._stacks: Dict[int, _AllocatorStack] = {}
         self._handles: Dict[int, DeviceBuffer] = {}
         self._next_handle = 1
         self._in_use_bytes = 0
+        self._host_bytes = 0
         self._use_clock = 0
         self._spill_count = 0
+        self._disk_spill_count = 0
         self._pins: Dict[int, int] = {}  # handle -> pin refcount
+        self._pin_threads: Dict[int, List[int]] = {}  # handle -> owner idents
+        # budget reserved by get() for slabs not yet in the handle
+        # table: invisible to victim picks, but a reason to WAIT
+        self._allocating = 0
+        # waiters in _make_room blocked on pinned residents; notified on
+        # any pin drop or budget release
+        self._evict_cond = threading.Condition()
         self._lock = threading.Lock()
         self._stopped = False
         # optional warm-up (reference maxAggPrealloc, RdmaBufferManager.java:84-91)
@@ -206,10 +316,85 @@ class DeviceBufferManager:
             self._use_clock += 1
             buf.last_use = self._use_clock
 
+    def _disk_path(self, handle: int) -> str:
+        d = self._spill_dir or tempfile.gettempdir()
+        return f"{d}/hbm-spill-{id(self)}-{handle}.bin"
+
+    def _pin(self, handle: int) -> None:
+        with self._lock:
+            self._pins[handle] = self._pins.get(handle, 0) + 1
+            self._pin_threads.setdefault(handle, []).append(
+                threading.get_ident()
+            )
+
+    def _unpin(self, handle: int) -> None:
+        with self._lock:
+            c = self._pins.get(handle, 0) - 1
+            if c > 0:
+                self._pins[handle] = c
+            else:
+                self._pins.pop(handle, None)
+            owners = self._pin_threads.get(handle)
+            if owners:
+                try:
+                    owners.remove(threading.get_ident())
+                except ValueError:
+                    pass
+                if not owners:
+                    self._pin_threads.pop(handle, None)
+        with self._evict_cond:
+            self._evict_cond.notify_all()
+
     def _on_spill(self, buf: DeviceBuffer) -> None:
         with self._lock:
             self._in_use_bytes -= buf.capacity
+            self._host_bytes += buf.capacity
             self._spill_count += 1
+        with self._evict_cond:
+            self._evict_cond.notify_all()
+        self._cascade_host_tier()
+
+    def _on_disk_spill(self, buf: DeviceBuffer) -> None:
+        with self._lock:
+            self._host_bytes -= buf.capacity
+            self._disk_spill_count += 1
+
+    def _pick_host_victim(self, exclude_handle: int) -> Optional[DeviceBuffer]:
+        with self._lock:
+            candidates = [
+                b
+                for b in self._handles.values()
+                if b.handle != exclude_handle
+                and b.handle not in self._pins
+                and b._host is not None
+            ]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda b: b.last_use)
+
+    def _cascade_host_tier(self, exclude_handle: int = -1) -> None:
+        """Push LRU host-tier residents to disk while over the host cap."""
+        while True:
+            with self._lock:
+                if not self.max_host_bytes or self._host_bytes <= self.max_host_bytes:
+                    return
+            victim = self._pick_host_victim(exclude_handle)
+            if victim is None:
+                return  # everything host-resident is excluded/pinned
+            victim.spill_to_disk()
+
+    def _reserve_host(self, buf: DeviceBuffer) -> None:
+        """Account a disk -> host climb (cascading others down first;
+        safe under the climber's tier lock — the climber is pinned, so
+        no victim pick can wait on it)."""
+        with self._lock:
+            self._host_bytes += buf.capacity
+        self._cascade_host_tier(exclude_handle=buf.handle)
+
+    def _unreserve_host(self, buf: DeviceBuffer) -> None:
+        """Roll back a failed disk -> host climb."""
+        with self._lock:
+            self._host_bytes -= buf.capacity
 
     def _pick_spill_victim(self, pinned) -> Optional[DeviceBuffer]:
         with self._lock:
@@ -227,25 +412,44 @@ class DeviceBufferManager:
 
     def _make_room(self, cls: int, pinned=frozenset()) -> None:
         """Spill LRU device-resident buffers (never a ``pinned`` handle)
-        until ``cls`` bytes fit."""
+        until ``cls`` bytes fit.
+
+        When every resident slab is pinned by OTHER threads (concurrent
+        climbers mid-restore), those pins are transient — wait for one
+        to drop instead of failing a healthy pool. Raise immediately
+        when only this thread's own pins block the way (waiting would
+        self-deadlock), or after a deadline (wedged pin holder)."""
+        me = threading.get_ident()
+        deadline = time.monotonic() + 30.0
         while True:
             with self._lock:
                 if not self.max_bytes or self._in_use_bytes + cls <= self.max_bytes:
                     return
             victim = self._pick_spill_victim(pinned)
-            if victim is None:
-                with self._lock:
-                    in_use = self._in_use_bytes
+            if victim is not None:
+                victim.spill_to_host()
+                continue
+            with self._lock:
+                foreign_pins = any(
+                    (b := self._handles.get(h)) is not None
+                    and b.array is not None
+                    and any(t != me for t in self._pin_threads.get(h, ()))
+                    for h in self._pins
+                ) or self._allocating > 0
+                in_use = self._in_use_bytes
+            if not foreign_pins or time.monotonic() > deadline:
                 raise MemoryError(
                     f"HBM shuffle budget exceeded: in-use {in_use}B + {cls}B "
                     f"> cap {self.max_bytes}B and nothing left to spill"
                 )
-            victim.spill_to_host()
+            with self._evict_cond:
+                self._evict_cond.wait(0.05)
 
     def _reserve_for_restore(self, buf: DeviceBuffer) -> None:
         self._make_room(buf.capacity, {buf.handle})
         with self._lock:
             self._in_use_bytes += buf.capacity
+            self._host_bytes -= buf.capacity  # leaving the host tier
             self._use_clock += 1
             buf.last_use = self._use_clock
 
@@ -274,9 +478,8 @@ class DeviceBufferManager:
                     f"{self.max_bytes}B; consume in smaller batches"
                 )
         handles = [b.handle for b in bufs]
-        with self._lock:
-            for h in handles:
-                self._pins[h] = self._pins.get(h, 0) + 1
+        for h in handles:
+            self._pin(h)
         try:
             for b in bufs:
                 b.ensure_device()
@@ -285,13 +488,8 @@ class DeviceBufferManager:
                 self._touch(b)
             yield
         finally:
-            with self._lock:
-                for h in handles:
-                    c = self._pins.get(h, 0) - 1
-                    if c > 0:
-                        self._pins[h] = c
-                    else:
-                        self._pins.pop(h, None)
+            for h in handles:
+                self._unpin(h)
 
     def ensure_device_all(self, bufs) -> None:
         """Restore a working set to HBM without the set victimizing
@@ -330,34 +528,67 @@ class DeviceBufferManager:
             self._next_handle += 1
             stack.total_alloc += 1
             self._in_use_bytes += cls
-        arr = jax.device_put(jnp.zeros((cls,), dtype=jnp.uint8), self.device)
-        buf = DeviceBuffer(handle, cls, arr, self)
-        buf.length = nbytes
-        with self._lock:
-            self._handles[handle] = buf
-            self._use_clock += 1
-            buf.last_use = self._use_clock
+            # budget held for a slab not yet visible in the handle
+            # table: concurrent _make_room callers must WAIT for it to
+            # materialize, not conclude the pool is unspillable
+            self._allocating += 1
+        try:
+            arr = jax.device_put(jnp.zeros((cls,), dtype=jnp.uint8), self.device)
+            buf = DeviceBuffer(handle, cls, arr, self)
+            buf.length = nbytes
+            with self._lock:
+                self._handles[handle] = buf
+                self._use_clock += 1
+                buf.last_use = self._use_clock
+        finally:
+            with self._lock:
+                self._allocating -= 1
+            with self._evict_cond:
+                self._evict_cond.notify_all()
         return buf
 
     def put(self, buf: DeviceBuffer) -> None:
-        """Return a slab to its class stack (RdmaBufferManager.java:120-127)."""
-        with self._lock:
-            if self._handles.pop(buf.handle, None) is None:
-                return  # double-free tolerated, like onFailure reentry
-            # freeing while pinned is a caller bug; don't let the stale
-            # pin shield a recycled slab from eviction forever
-            self._pins.pop(buf.handle, None)
-            if buf.spilled:
-                # spilled slabs released their device budget already and
-                # have no device array to pool — just drop the host copy
-                buf._host = None
+        """Return a slab to its class stack (RdmaBufferManager.java:120-127).
+
+        Takes the buffer's tier lock so a manager-initiated cascade
+        mid-move on this buffer finishes (or sees it gone) before the
+        tiers are torn down."""
+        with buf._tier_lock:
+            with self._lock:
+                if self._handles.pop(buf.handle, None) is None:
+                    return  # double-free tolerated, like onFailure reentry
+                # freeing while pinned is a caller bug; don't let the
+                # stale pin shield a recycled slab from eviction forever
+                self._pins.pop(buf.handle, None)
+                self._pin_threads.pop(buf.handle, None)
+                if buf.spilled:
+                    # spilled slabs released their device budget already
+                    # and have no device array to pool — drop whichever
+                    # lower tier holds the bytes
+                    if buf._host is not None:
+                        self._host_bytes -= buf.capacity
+                        buf._host = None
+                    disk, buf._disk = buf._disk, None
+                else:
+                    disk = None
+            if disk is not None:
+                try:
+                    os.unlink(disk[0])
+                except OSError:
+                    pass
+            if buf.array is None:
                 return
-            self._in_use_bytes -= buf.capacity
-            if self._stopped:
-                buf.array.delete()
-                return
-            self._stacks[buf.capacity].stack.append(buf)
-        buf.length = 0
+            with self._lock:
+                self._in_use_bytes -= buf.capacity
+                stopped = self._stopped
+                if stopped:
+                    buf.array.delete()
+                else:
+                    self._stacks[buf.capacity].stack.append(buf)
+            with self._evict_cond:
+                self._evict_cond.notify_all()
+            if not stopped:
+                buf.length = 0
 
     def resolve(self, handle: int) -> DeviceBuffer:
         """Handle table lookup — the mkey/rkey resolution analogue."""
@@ -424,6 +655,16 @@ class DeviceBufferManager:
         with self._lock:
             return self._spill_count
 
+    @property
+    def disk_spill_count(self) -> int:
+        with self._lock:
+            return self._disk_spill_count
+
+    @property
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes
+
     def stats(self) -> Dict[int, Dict[str, int]]:
         with self._lock:
             return {
@@ -457,3 +698,9 @@ class DeviceBufferManager:
             if buf.array is not None:
                 buf.array.delete()
             buf._host = None
+            if buf._disk is not None:
+                try:
+                    os.unlink(buf._disk[0])
+                except OSError:
+                    pass
+                buf._disk = None

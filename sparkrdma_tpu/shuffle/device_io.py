@@ -45,6 +45,8 @@ class DeviceShuffleIO:
             max_bytes=conf.hbm_max_bytes,
             prealloc=conf.max_agg_prealloc,
             prealloc_size=conf.max_agg_block,
+            max_host_bytes=conf.hbm_host_spill_max_bytes,
+            spill_dir=conf.hbm_spill_dir or None,
         )
         # published host-side registered buffers per shuffle (kept alive
         # until unpublish — the serving side of one-sided READs)
@@ -236,6 +238,7 @@ class DeviceShuffleIO:
         }
         snap["hbm_in_use_bytes"] = self._dev.in_use_bytes
         snap["hbm_spill_count"] = self._dev.spill_count
+        snap["hbm_disk_spill_count"] = self._dev.disk_spill_count
         return snap
 
     def unpublish(self, shuffle_id: int) -> None:

@@ -252,3 +252,17 @@ class TpuShuffleConf:
     def hbm_max_bytes(self) -> int:
         """HBM budget for shuffle staging (analogue of the 25g host budget)."""
         return self._bytes("hbm.maxBytes", "2g", 0, 1 << 40)
+
+    @property
+    def hbm_host_spill_max_bytes(self) -> int:
+        """Host-RAM cap for slabs spilled out of HBM; overflow cascades
+        to disk (tier 3 of SURVEY §7.3(4)). 0 = unbounded host tier."""
+        return self._bytes("hbm.hostSpillMaxBytes", "0", 0, 1 << 44)
+
+    @property
+    def hbm_spill_dir(self) -> str:
+        """Directory for the disk tier's spill files. Default ("") uses
+        the system temp dir — NOTE: on hosts where /tmp is tmpfs that
+        is still RAM; point this at real storage when using
+        hbm.hostSpillMaxBytes to protect host memory."""
+        return str(self.get(PREFIX + "hbm.spillDir", "") or "")

@@ -112,6 +112,64 @@ def test_fetch_under_hbm_budget_pressure_spills_and_survives():
         driver.stop()
 
 
+def test_fetch_fault_surfaces_and_leaks_nothing(cluster, monkeypatch):
+    """Inject a READ fault at the verb seam during a device-block
+    fetch: the caller gets FetchFailedError (engine recompute signal,
+    SURVEY §5.1 #9) and BOTH pools drain — staged HBM slabs freed,
+    every in-flight registered destination buffer reclaimed by
+    whichever of caller/listener turns out to be its last owner."""
+    import threading
+
+    from sparkrdma_tpu.shuffle.errors import FetchFailedError
+    from sparkrdma_tpu.transport.channel import ChannelError, TpuChannel
+
+    conf, driver, ex0, ex1 = cluster
+    handle = BaseShuffleHandle(
+        shuffle_id=5, num_maps=2, partitioner=HashPartitioner(4)
+    )
+    driver.register_shuffle(handle)
+    io0, io1 = DeviceShuffleIO(ex0), DeviceShuffleIO(ex1)
+    rng = np.random.default_rng(3)
+    try:
+        io0.publish_device_blocks(
+            5, {p: rng.integers(0, 256, 5000, np.uint8) for p in range(4)}
+        )
+        io1.publish_device_blocks(
+            5, {p: rng.integers(0, 256, 5000, np.uint8) for p in range(4)}
+        )
+        state = {"remaining": 1}
+        lock = threading.Lock()
+        original = TpuChannel.read_in_queue
+
+        def flaky(self, listener, dst_views, blocks):
+            with lock:
+                inject = state["remaining"] > 0
+                if inject:
+                    state["remaining"] -= 1
+            if inject:
+                listener.on_failure(ChannelError("injected device-fetch fault"))
+                return
+            return original(self, listener, dst_views, blocks)
+
+        monkeypatch.setattr(TpuChannel, "read_in_queue", flaky)
+        with pytest.raises(FetchFailedError):
+            io0.fetch_device_blocks(5, 0, 4, timeout_s=30)
+        # nothing leaked on either tier
+        assert io0.device_buffers.in_use_bytes == 0
+        # all registered destination buffers back in the pool: a clean
+        # retry (fault healed) succeeds and is byte-exact
+        state["remaining"] = 0
+        got = io0.fetch_device_blocks(5, 0, 4, timeout_s=30)
+        assert sum(len(b) for b in got.values()) == 8
+        for bufs in got.values():
+            for b in bufs:
+                b.free()
+        assert io0.device_buffers.in_use_bytes == 0
+    finally:
+        io0.stop()
+        io1.stop()
+
+
 def test_unpublish_releases_registered_buffers(cluster):
     conf, driver, ex0, ex1 = cluster
     handle = BaseShuffleHandle(shuffle_id=2, num_maps=1, partitioner=HashPartitioner(1))

@@ -101,6 +101,46 @@ def test_pinned_working_set_never_victimized():
     mgr.stop()
 
 
+def test_three_tier_spill_hbm_host_disk(tmp_path):
+    """SURVEY §7.3(4): HBM -> host RAM -> disk, byte-exact reads from
+    every tier, transparent climb back, accounting that returns to
+    zero, and no spill files left behind."""
+    import os
+
+    budget = 2 * MIN_BLOCK_SIZE       # 2 slabs in HBM
+    host_cap = 2 * MIN_BLOCK_SIZE     # 2 slabs in host RAM
+    mgr = DeviceBufferManager(
+        max_bytes=budget, max_host_bytes=host_cap, spill_dir=str(tmp_path)
+    )
+    payload = [bytes([i]) * (MIN_BLOCK_SIZE - 64) for i in range(6)]
+    bufs = [mgr.stage_bytes(p) for p in payload]
+    # 6 slabs through a 2-slab HBM budget: 4 spilled to host, and the
+    # 2-slab host cap cascaded 2 of those onward to disk
+    assert mgr.spill_count >= 4
+    assert mgr.disk_spill_count >= 2
+    assert mgr.in_use_bytes <= budget
+    assert mgr.host_bytes <= host_cap
+    tiers = {"device": 0, "host": 0, "disk": 0}
+    for b in bufs:
+        tiers["disk" if b.on_disk else "host" if b._host is not None
+              else "device"] += 1
+    assert tiers == {"device": 2, "host": 2, "disk": 2}
+    # byte-exact from every tier (disk reads via memmap, no restore)
+    for b, p in zip(bufs, payload):
+        assert b.read(0, len(p)) == p
+    # climb a disk-tier buffer all the way back to the device
+    deep = next(b for b in bufs if b.on_disk)
+    deep.ensure_device()
+    assert deep.array is not None and not deep.spilled
+    assert deep.read(0, deep.length) == payload[bufs.index(deep)]
+    assert mgr.in_use_bytes <= budget and mgr.host_bytes <= host_cap
+    for b in bufs:
+        b.free()
+    assert mgr.in_use_bytes == 0 and mgr.host_bytes == 0
+    assert list(tmp_path.iterdir()) == [], "spill files leaked"
+    mgr.stop()
+
+
 def test_pool_reuse_same_class():
     mgr = DeviceBufferManager()
     a = mgr.get(20_000)

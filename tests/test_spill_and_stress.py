@@ -150,3 +150,44 @@ def test_native_send_budget_overflow_drains():
     finally:
         a.stop()
         b.stop()
+
+
+def test_tiered_hbm_pool_threaded_stress(tmp_path):
+    """Hammer the three-tier HBM pool from several threads: stage,
+    read, climb, and free race the manager-initiated spill cascades.
+    The per-buffer tier locks must keep every read byte-exact and the
+    accounting must return to zero with no spill files left."""
+    from sparkrdma_tpu.ops.hbm_arena import MIN_BLOCK_SIZE, DeviceBufferManager
+
+    mgr = DeviceBufferManager(
+        max_bytes=3 * MIN_BLOCK_SIZE,
+        max_host_bytes=2 * MIN_BLOCK_SIZE,
+        spill_dir=str(tmp_path),
+    )
+    errors = []
+    rounds = 30
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for i in range(rounds):
+                payload = bytes([seed]) * int(rng.integers(64, MIN_BLOCK_SIZE))
+                buf = mgr.stage_bytes(payload)
+                if rng.integers(2):
+                    buf.ensure_device()
+                got = buf.read(0, len(payload))
+                if got != payload:
+                    errors.append(f"thread {seed} round {i}: bytes differ")
+                buf.free()
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"thread {seed}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors[:5]
+    assert mgr.in_use_bytes == 0 and mgr.host_bytes == 0
+    assert list(tmp_path.iterdir()) == [], "spill files leaked"
+    mgr.stop()
