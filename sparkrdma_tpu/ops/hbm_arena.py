@@ -101,15 +101,17 @@ class DeviceBuffer:
 
     def spill_to_host(self) -> None:
         """HBM -> host RAM; releases device budget, keeps the handle.
-        May cascade another buffer host -> disk under the host cap
-        (cascade runs after this buffer's lock is released)."""
+        May cascade another buffer host -> disk under the host cap.
+        The cascade MUST run after this buffer's lock is released: it
+        can legally pick this very buffer (freshly host-resident, LRU)
+        and would self-deadlock on the non-reentrant tier lock."""
         with self._tier_lock:
             if self.array is None:
                 return  # raced: someone else already moved it
             self._host = np.asarray(self.array)
             self.array.delete()
             self.array = None
-            self._manager._on_spill(self)
+            self._manager._on_spill_accounting(self)
         self._manager._cascade_host_tier()
 
     def spill_to_disk(self) -> None:
@@ -147,6 +149,12 @@ class DeviceBuffer:
     def _climb_locked(self) -> None:
         """To device residency; tier lock held, self pinned."""
         if self.array is not None:
+            return
+        if self._host is None and self._disk is None:
+            # freed out from under a concurrent climb (put() won the
+            # tier lock first and tore the tiers down) — restoring
+            # nothing must charge nothing, or the budget counters
+            # corrupt silently (a prefetch racing free() hits this)
             return
         self._ensure_host_locked()
         self._manager._reserve_for_restore(self)
@@ -345,14 +353,15 @@ class DeviceBufferManager:
         with self._evict_cond:
             self._evict_cond.notify_all()
 
-    def _on_spill(self, buf: DeviceBuffer) -> None:
+    def _on_spill_accounting(self, buf: DeviceBuffer) -> None:
+        """Device -> host budget transfer. Safe under the mover's tier
+        lock — the follow-up cascade is the CALLER's duty, outside it."""
         with self._lock:
             self._in_use_bytes -= buf.capacity
             self._host_bytes += buf.capacity
             self._spill_count += 1
         with self._evict_cond:
             self._evict_cond.notify_all()
-        self._cascade_host_tier()
 
     def _on_disk_spill(self, buf: DeviceBuffer) -> None:
         with self._lock:
@@ -498,6 +507,29 @@ class DeviceBufferManager:
         ``pinned_on_device(bufs)`` across the access instead."""
         with self.pinned_on_device(bufs):
             pass
+
+    def prefetch(self, bufs) -> threading.Event:
+        """Start climbing ``bufs`` back toward HBM on a background
+        thread — the "prefetch back to HBM on fetch" of SURVEY
+        §7.3(4), overlapping tier restores with whatever the caller
+        computes next. Returns an Event set when the pass finishes
+        (success or not). The climb uses the same pinned restore as
+        ``ensure_device_all``; consumers still wrap their access in
+        ``pinned_on_device`` (a fast no-op once prefetched). Best
+        effort: under budget pressure later traffic may re-spill."""
+        bufs = list(bufs)
+        done = threading.Event()
+
+        def run():
+            try:
+                self.ensure_device_all(bufs)
+            except Exception:
+                logger.exception("hbm prefetch pass failed")
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True, name="hbm-prefetch").start()
+        return done
 
     def get(self, nbytes: int) -> DeviceBuffer:
         """Allocate (or reuse) a slab whose class covers ``nbytes``.

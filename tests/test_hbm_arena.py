@@ -141,6 +141,53 @@ def test_three_tier_spill_hbm_host_disk(tmp_path):
     mgr.stop()
 
 
+def test_prefetch_restores_in_background(tmp_path):
+    """prefetch() climbs a spilled set back to HBM off-thread; a later
+    pinned_on_device is then a fast no-op."""
+    budget = 2 * MIN_BLOCK_SIZE
+    mgr = DeviceBufferManager(
+        max_bytes=budget, max_host_bytes=MIN_BLOCK_SIZE,
+        spill_dir=str(tmp_path),
+    )
+    payload = [bytes([i]) * 200 for i in range(4)]
+    bufs = [mgr.stage_bytes(p) for p in payload]
+    assert any(b.spilled for b in bufs[:2])  # pushed out by later stages
+    done = mgr.prefetch(bufs[:2])
+    assert done.wait(30)
+    assert all(not b.spilled for b in bufs[:2])
+    with mgr.pinned_on_device(bufs[:2]):
+        for b, p in zip(bufs[:2], payload[:2]):
+            assert b.read(0, len(p)) == p
+    for b in bufs:
+        b.free()
+    mgr.stop()
+
+
+def test_climb_after_free_charges_nothing(tmp_path):
+    """A restore racing free() (the prefetch pattern) must not charge
+    budget for a buffer whose tiers were already torn down."""
+    mgr = DeviceBufferManager(
+        max_bytes=2 * MIN_BLOCK_SIZE, spill_dir=str(tmp_path)
+    )
+    a = mgr.stage_bytes(b"a" * 100)
+    b = mgr.stage_bytes(b"b" * 100)
+    c = mgr.stage_bytes(b"c" * 100)  # spills a
+    assert a.spilled
+    a.free()  # freed while spilled — tiers torn down
+    before_dev, before_host = mgr.in_use_bytes, mgr.host_bytes
+    a.ensure_device()  # the racing climb: must be a no-op
+    assert a.array is None
+    assert mgr.in_use_bytes == before_dev
+    assert mgr.host_bytes == before_host
+    done = mgr.prefetch([a, b])  # mixed dead/live set: completes
+    assert done.wait(30)
+    assert not b.spilled
+    for buf in (b, c):
+        buf.free()
+    assert mgr.in_use_bytes == 0 and mgr.host_bytes == 0
+    mgr.stop()
+
+
 def test_pool_reuse_same_class():
     mgr = DeviceBufferManager()
     a = mgr.get(20_000)
