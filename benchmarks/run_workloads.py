@@ -238,47 +238,85 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             )
         phases_compile = time.perf_counter() - t0
 
+        # fetch/compute overlap (SURVEY §2.3): the next reducer's
+        # READ + HBM staging runs on a worker thread while the device
+        # merges the current one — the e2e exercises the same overlap
+        # the fetcher gives record-plane readers. Phase timers count
+        # BUSY time per plane; with overlap their sum exceeds wall.
+        from concurrent.futures import ThreadPoolExecutor
+
         t_fetch = t_merge = 0.0
-        reducer_io = ios[0]
-        for r in range(reducers):
+
+        def fetch_one(r):
+            nonlocal t_fetch
             t0 = time.perf_counter()
             got = reducer_io.fetch_device_blocks(
                 99, r, r + 1, dtype=np.uint32, timeout_s=120
             )
-            bufs = got[r]
             t_fetch += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            # pin the set device-resident across the direct .array
-            # access (no-op unless HBM pressure spilled some; members
-            # are never victims while pinned)
-            with reducer_io.device_buffers.pinned_on_device(bufs):
-                cap = max(b.array.shape[0] for b in bufs)
-                arrs = tuple(
-                    b.array
-                    if b.array.shape[0] == cap
-                    else jnp.zeros((cap,), jnp.uint32)
-                    .at[: b.array.shape[0]]
-                    .set(b.array)
-                    for b in bufs
-                )
-                counts = jnp.asarray([b.length // 4 for b in bufs], jnp.int32)
-                merged, packed = merge(arrs, counts)
-            # ONE readback: [count, sum, xor, sorted]
-            t, csum, cxor, ok = (int(x) for x in np.asarray(packed))
-            if t != exp_cnt[r]:
-                raise SystemExit(
-                    f"E2E FAILED: reducer {r} count {t} != {exp_cnt[r]}"
-                )
-            if csum != int(exp_sum[r]) or cxor != int(exp_xor[r]):
-                raise SystemExit(f"E2E FAILED: reducer {r} checksum mismatch")
-            if not ok:
-                raise SystemExit(f"E2E FAILED: reducer {r} output not sorted")
-            for b in bufs:
-                b.free()
-            del merged
-            t_merge += time.perf_counter() - t0
-        phases["fetch_stage_s"] = t_fetch
-        phases["device_merge_s"] = t_merge
+            return got[r]
+
+        reducer_io = ios[0]
+        t_wall0 = time.perf_counter()
+        pool = ThreadPoolExecutor(1, thread_name_prefix="e2e-fetch")
+        try:
+            fut = pool.submit(fetch_one, 0)
+            for r in range(reducers):
+                bufs = fut.result()
+                if r + 1 < reducers:
+                    fut = pool.submit(fetch_one, r + 1)
+                t0 = time.perf_counter()
+                # pin the set device-resident across the direct .array
+                # access (no-op unless HBM pressure spilled some;
+                # members are never victims while pinned)
+                with reducer_io.device_buffers.pinned_on_device(bufs):
+                    cap = max(b.array.shape[0] for b in bufs)
+                    arrs = tuple(
+                        b.array
+                        if b.array.shape[0] == cap
+                        else jnp.zeros((cap,), jnp.uint32)
+                        .at[: b.array.shape[0]]
+                        .set(b.array)
+                        for b in bufs
+                    )
+                    counts = jnp.asarray(
+                        [b.length // 4 for b in bufs], jnp.int32
+                    )
+                    merged, packed = merge(arrs, counts)
+                # ONE readback: [count, sum, xor, sorted]
+                t, csum, cxor, ok = (int(x) for x in np.asarray(packed))
+                if t != exp_cnt[r]:
+                    raise SystemExit(
+                        f"E2E FAILED: reducer {r} count {t} != {exp_cnt[r]}"
+                    )
+                if csum != int(exp_sum[r]) or cxor != int(exp_xor[r]):
+                    raise SystemExit(
+                        f"E2E FAILED: reducer {r} checksum mismatch"
+                    )
+                if not ok:
+                    raise SystemExit(
+                        f"E2E FAILED: reducer {r} output not sorted"
+                    )
+                for b in bufs:
+                    b.free()
+                del merged
+                t_merge += time.perf_counter() - t0
+        finally:
+            # a verification failure or fetch fault must not tear down
+            # executors underneath the in-flight prefetch, nor hang
+            # interpreter exit joining a 120 s fetch
+            pool.shutdown(wait=False, cancel_futures=True)
+        reduce_wall = time.perf_counter() - t_wall0
+        # only wall time counts toward the total; per-plane busy times
+        # are informational (they overlap)
+        phases["reduce_wall_s"] = reduce_wall
+        extra_busy = {
+            "fetch_stage_busy_s": round(t_fetch, 3),
+            "device_merge_busy_s": round(t_merge, 3),
+            "overlap_saved_s": round(
+                max(0.0, t_fetch + t_merge - reduce_wall), 3
+            ),
+        }
         # live observability counters (pool allocs, read-path split,
         # fetch histograms, HBM budget/spills) into the artifact
         metrics = reducer_io.metrics_snapshot()
@@ -299,12 +337,14 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         compile_warm_s=round(phases_compile, 3),
         verified="count+sum+xor+sorted (on-device)",
         metrics=metrics,
+        **extra_busy,
         note=(
-            "single-host rig: fetch_stage/device_merge phases are "
-            "dominated by axon-tunnel dispatch+transfer latency, not "
-            "framework code (bench.py measures the planes in "
-            "isolation); the reference's 1.41x was multi-node where "
-            "shuffle crosses a real network"
+            "single-host rig: reduce_wall_s (and the overlapped "
+            "fetch_stage_busy_s / device_merge_busy_s it is built "
+            "from) is dominated by axon-tunnel dispatch+transfer "
+            "latency, not framework code (bench.py measures the "
+            "planes in isolation); the reference's 1.41x was "
+            "multi-node where shuffle crosses a real network"
         ),
         **{k: round(v, 3) for k, v in phases.items()},
     )

@@ -661,7 +661,16 @@ class DeviceBufferManager:
         n = src.nbytes if valid_len is None else valid_len
         buf = self.get(n)
         if src.nbytes >= buf.capacity:
-            arr = jax.device_put(src[: buf.capacity].view(dtype), buf.device)
+            typed = src[: buf.capacity].view(dtype)
+            if buf.device.platform == "cpu":
+                # the CPU backend's device_put may ALIAS host memory
+                # zero-copy — but the source is a pooled registered
+                # buffer the caller recycles immediately, so a later
+                # fetch would overwrite these "device" bytes in place
+                # (caught by the overlapped e2e on the CPU mesh; TPU
+                # always DMAs a real copy)
+                typed = typed.copy()
+            arr = jax.device_put(typed, buf.device)
         else:
             # short source (not from a pooled class): pad host-side —
             # one memcpy, still compile-free
