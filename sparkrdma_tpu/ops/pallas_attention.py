@@ -28,7 +28,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             *, scale, causal, block_q, block_k, num_kv_blocks, seq_len,
             precision):
     iq = pl.program_id(2)
@@ -85,9 +85,366 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     @pl.when(ik == num_kv_blocks - 1)
     def _finalize():
         l = l_ref[...][:, 0]
-        # fully-masked rows (query padding) have l == 0; emit zeros
+        m = m_ref[...][:, 0]
+        # fully-masked rows (query padding) have l == 0; emit zeros,
+        # and pin their logsumexp to +inf-ish so the backward's
+        # exp(s - lse) is exactly 0 there (m + log 0 would be nan)
         denom = jnp.where(l > 0, l, 1.0)
         o_ref[0, 0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:  # static: training variant only
+            lse = jnp.where(l > 0, m + jnp.log(denom), -NEG_INF)
+            # row-statistic layout: one [8, L] tile per q block with
+            # L = max(block_q, 128) — 8 replicated sublanes and a
+            # 128-divisible lane slot keep Mosaic's (8, 128) block
+            # alignment even for small clamped blocks (a bare
+            # [1, block_q] block fails lowering when block_q < 128)
+            L = lse_ref.shape[-1]
+            if L > block_q:
+                lse = jnp.pad(lse, (0, L - block_q))
+            lse_ref[0, 0] = jnp.broadcast_to(
+                lse[None, :].astype(jnp.float32), lse_ref.shape[2:]
+            )
+
+
+def _kernel_no_lse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, **kw):
+    _kernel(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref, **kw)
+
+
+def _resolve_blocks(s: int, block_q: int, block_k: int):
+    """Clamp blocks for short sequences to the next power of two <= s
+    (>= 8): power-of-two blocks keep Mosaic-friendly (8, 128)-tile
+    alignment, where a raw s clamp (e.g. 300) would build unaligned
+    block shapes and iotas. The padded length must divide by BOTH
+    block sizes, or kv blocks past s_pad//block_k would silently never
+    be visited."""
+    if s < block_q:
+        block_q = max(8, 1 << (s.bit_length() - 1))
+    if s < block_k:
+        block_k = max(8, 1 << (s.bit_length() - 1))
+    lcm = math.lcm(block_q, block_k)
+    s_pad = int(math.ceil(s / lcm)) * lcm
+    return block_q, block_k, s_pad
+
+
+def _prep(x, s, s_pad):
+    x = jnp.transpose(x, (0, 2, 1, 3))  # [B, H, S, D]
+    if s_pad != s:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+    return x
+
+
+def _fwd_impl(q, k, v, causal, block_q, block_k, interpret, precision,
+              want_lse):
+    """[B, S, H, D] -> (out [B, S, H, D], lse or None).
+
+    ``lse`` (training only, ``want_lse=True``) is [B, H, 8, nq * L]
+    f32 with L = max(block_q, 128): one lane slot of L per q block,
+    value in the first block_q lanes of sublane-replicated rows (see
+    the layout note in ``_kernel``). Inference skips the output
+    entirely."""
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k, s_pad = _resolve_blocks(s, block_q, block_k)
+    qt, kt, vt = (_prep(x, s, s_pad) for x in (q, k, v))
+    nq = s_pad // block_q
+    nk = s_pad // block_k
+
+    kernel = functools.partial(
+        _kernel if want_lse else _kernel_no_lse,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_kv_blocks=nk,
+        seq_len=s,
+        precision=precision,
+    )
+    L = max(block_q, 128)
+    if causal:
+        # above-diagonal kv blocks are skipped by the kernel; clamp their
+        # index to the last live block so the pipeline re-addresses the
+        # already-resident tile instead of DMAing a dead one from HBM
+        def kv_index(bi, hi, qi, ki):
+            last_live = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi, jnp.minimum(ki, last_live), 0)
+    else:
+        def kv_index(bi, hi, qi, ki):
+            return (bi, hi, ki, 0)
+
+    out_specs = [
+        pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype)]
+    if want_lse:
+        out_specs.append(
+            pl.BlockSpec(
+                (1, 1, 8, L), lambda bi, hi, qi, ki: (bi, hi, 0, qi)
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, 8, nq * L), jnp.float32)
+        )
+    res = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denominator
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    out, lse = res if want_lse else (res[0], None)
+    out = jnp.transpose(out[:, :, :s, :], (0, 2, 1, 3))
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2 shape): two kernels re-materialize the
+# probability tiles from (q, k, lse) so the [Sq, Sk] matrices never
+# exist in HBM in the backward either. delta = rowsum(dout * out) is
+# precomputed at the jnp level (elementwise; XLA fuses it).
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k,
+               num_kv_blocks, seq_len, precision):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)      # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)    # [bq, d]
+        lse = lse_ref[0, 0, 0][:block_q]         # [bq] (row 0, L-slot)
+        dlt = dlt_ref[0, 0, 0][:block_q]         # [bq]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ) * scale
+        kv_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = kv_pos < seq_len
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            mask = mask & (q_pos >= kv_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])            # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )                                         # [bq, bk]
+        ds = p * (dp - dlt[:, None])
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ) * scale
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                block_q, block_k, num_q_blocks, seq_len, precision):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks strictly above the diagonal see none of this kv
+    # block — the transpose of the forward's skip
+    live = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)      # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)      # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)    # [bq, d]
+        lse = lse_ref[0, 0, 0][:block_q]         # [bq] (row 0, L-slot)
+        dlt = dlt_ref[0, 0, 0][:block_q]         # [bq]
+
+        # transposed orientation: rows = kv positions
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ) * scale                                 # [bk, bq]
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1
+        )
+        kv_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0
+        )
+        mask = (q_pos < seq_len) & (kv_pos < seq_len)
+        if causal:
+            mask = mask & (q_pos >= kv_pos)
+        s_t = jnp.where(mask, s_t, NEG_INF)
+        p_t = jnp.exp(s_t - lse[None, :])         # [bk, bq]
+        dv_acc[...] += jax.lax.dot_general(
+            p_t, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )                                          # [bk, bq]
+        ds_t = p_t * (dp_t - dlt[None, :])
+        dk_acc[...] += jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ) * scale
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, out, lse, do, causal, block_q, block_k,
+              interpret, precision):
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q, block_k, s_pad = _resolve_blocks(s, block_q, block_k)
+    nk = s_pad // block_k
+
+    nq = s_pad // block_q
+    L = max(block_q, 128)
+    # delta[b,h,q] = rowsum(dout * out) — elementwise, jnp-level;
+    # laid out like lse: one [8, L] lane slot per q block
+    delta = jnp.einsum(
+        "bshd,bshd->bhs", do.astype(jnp.float32), out.astype(jnp.float32)
+    )
+    if s_pad != s:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, s_pad - s)))
+    if L > block_q:
+        delta = delta.reshape(b, h, nq, block_q)
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, 0), (0, L - block_q)))
+        delta = delta.reshape(b, h, nq * L)
+    delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, nq * L))
+
+    qt, kt, vt, dot = (_prep(x, s, s_pad) for x in (q, k, v, do))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    row_spec = pl.BlockSpec((1, 1, 8, L), lambda bi, hi, qi, ki: (bi, hi, 0, qi))
+    if causal:
+        def kv_index(bi, hi, qi, ki):
+            last_live = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi, jnp.minimum(ki, last_live), 0)
+    else:
+        def kv_index(bi, hi, qi, ki):
+            return (bi, hi, ki, 0)
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), kv_index)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, num_kv_blocks=nk, seq_len=s,
+            precision=precision,
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # kv-major grid: q minor so dk/dv accumulators live across the sweep
+    if causal:
+        def q_index(bi, hi, ki, qi):
+            first_live = (ki * block_k) // block_q
+            return (bi, hi, jnp.maximum(qi, first_live), 0)
+
+        def row_index(bi, hi, ki, qi):
+            first_live = (ki * block_k) // block_q
+            return (bi, hi, 0, jnp.maximum(qi, first_live))
+    else:
+        def q_index(bi, hi, ki, qi):
+            return (bi, hi, qi, 0)
+
+        def row_index(bi, hi, ki, qi):
+            return (bi, hi, 0, qi)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, num_q_blocks=nq, seq_len=s,
+            precision=precision,
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, 8, L), row_index),
+            pl.BlockSpec((1, 1, 8, L), row_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    unprep = lambda x: jnp.transpose(x[:, :, :s, :], (0, 2, 1, 3))
+    return unprep(dq), unprep(dk), unprep(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, precision):
+    out, _ = _fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                       precision, want_lse=False)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, precision):
+    out, lse = _fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                         precision, want_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, precision, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, out, lse, do, causal, block_q, block_k,
+                     interpret, precision)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -99,6 +456,13 @@ def flash_attention(
     precision=None,
 ):
     """Exact attention over [B, S, H, D] inputs via a Pallas TPU kernel.
+
+    Differentiable: a custom VJP re-materializes probability tiles from
+    (q, k, logsumexp) in two Pallas kernels (dq with a kv-minor sweep,
+    dk/dv with a q-minor sweep), so neither direction ever holds an
+    [Sq, Sk] matrix in HBM — long-context TRAINING runs at flash
+    memory cost (the forward additionally saves one f32 logsumexp row
+    per query, [B, H, S]).
 
     ``interpret=None`` auto-selects interpreter mode off-TPU.
     ``precision=None`` uses HIGHEST for fp32 inputs (the MXU otherwise
@@ -119,70 +483,4 @@ def flash_attention(
             if q.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT
         )
-    b, s, h, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    # clamp blocks for short sequences to the next power of two <= s
-    # (>= 8): power-of-two blocks keep Mosaic-friendly (8, 128)-tile
-    # alignment, where a raw s clamp (e.g. 300) would build unaligned
-    # block shapes and iotas
-    if s < block_q:
-        block_q = max(8, 1 << (s.bit_length() - 1))
-    if s < block_k:
-        block_k = max(8, 1 << (s.bit_length() - 1))
-    # the padded length must divide by BOTH block sizes, or kv blocks
-    # past s_pad//block_k would silently never be visited
-    lcm = math.lcm(block_q, block_k)
-    s_pad = int(math.ceil(s / lcm)) * lcm
-
-    def prep(x):
-        x = jnp.transpose(x, (0, 2, 1, 3))  # [B, H, S, D]
-        if s_pad != s:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
-        return x
-
-    qt, kt, vt = prep(q), prep(k), prep(v)
-    nq = s_pad // block_q
-    nk = s_pad // block_k
-
-    kernel = functools.partial(
-        _kernel,
-        scale=scale,
-        causal=causal,
-        block_q=block_q,
-        block_k=block_k,
-        num_kv_blocks=nk,
-        seq_len=s,
-        precision=precision,
-    )
-    if causal:
-        # above-diagonal kv blocks are skipped by the kernel; clamp their
-        # index to the last live block so the pipeline re-addresses the
-        # already-resident tile instead of DMAing a dead one from HBM
-        def kv_index(bi, hi, qi, ki):
-            last_live = (qi * block_q + block_q - 1) // block_k
-            return (bi, hi, jnp.minimum(ki, last_live), 0)
-    else:
-        def kv_index(bi, hi, qi, ki):
-            return (bi, hi, ki, 0)
-
-    out = pl.pallas_call(
-        kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), kv_index),
-            pl.BlockSpec((1, 1, block_k, d), kv_index),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running denominator
-            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
-        ],
-        interpret=interpret,
-    )(qt, kt, vt)
-    out = out[:, :, :s, :]
-    return jnp.transpose(out, (0, 2, 1, 3))  # back to [B, S, H, D]
+    return _flash(q, k, v, causal, block_q, block_k, interpret, precision)
