@@ -31,6 +31,8 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.ops.ulysses_attention import ulysses_shard_attention
+
 NEG_INF = -1e30
 
 
@@ -117,12 +119,33 @@ def init_params(d_model: int, n_heads: int, d_hidden: int, tp: int, seed: int = 
 
 
 class TransformerStep:
-    """One-layer attention+MLP block with an SGD train step."""
+    """One-layer attention+MLP block with an SGD train step.
 
-    def __init__(self, mesh: Optional[Mesh] = None, n_heads: int = 4, lr: float = 0.1):
+    ``attn`` selects the sequence-parallel schedule:
+
+    - ``"ring"`` (default): kv blocks hop neighbour-to-neighbour over
+      the sp axis with an online-softmax accumulation — O(s/sp) memory,
+      jnp-level math, differentiated by autodiff through ppermute.
+    - ``"ulysses"``: two ``all_to_all``s re-shard seq<->heads and the
+      full-sequence attention per head group runs through the Pallas
+      flash kernel — trainable thanks to the kernel's custom VJP, so
+      the backward also never materializes [Sq, Sk]. Requires
+      ``n_heads % sp == 0``.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, n_heads: int = 4,
+                 lr: float = 0.1, attn: str = "ring"):
+        if attn not in ("ring", "ulysses"):
+            raise ValueError(f"unknown attn schedule {attn!r}")
         self.mesh = mesh if mesh is not None else make_training_mesh()
+        if attn == "ulysses" and n_heads % self.mesh.shape["sp"] != 0:
+            raise ValueError(
+                f"ulysses needs n_heads ({n_heads}) divisible by the sp "
+                f"axis ({self.mesh.shape['sp']})"
+            )
         self.n_heads = n_heads
         self.lr = lr
+        self.attn = attn
         self._cache: Dict = {}
 
     # ------------------------------------------------------------------
@@ -167,10 +190,19 @@ class TransformerStep:
                     vb = jax.lax.ppermute(vb, "sp", perm)
             return (num / den.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
+        def ulysses_attn(q, k, v):
+            # one shared shard-level schedule (ops/ulysses_attention):
+            # seq-gather / head-scatter, full-seq flash per head group,
+            # inverse exchange — gradients flow through all_to_all (its
+            # own transpose) and the flash kernel's custom VJP
+            return ulysses_shard_attention(q, k, v, "sp", sp, causal=False)
+
+        attn_fn = ring_attn if self.attn == "ring" else ulysses_attn
+
         def forward_local(params, x):
             bl, sl, _ = x.shape
             qkv = lambda w: (x @ w).reshape(bl, sl, heads, dhead)
-            attn = ring_attn(qkv(params["wq"]), qkv(params["wk"]), qkv(params["wv"]))
+            attn = attn_fn(qkv(params["wq"]), qkv(params["wk"]), qkv(params["wv"]))
             x = x + attn.reshape(bl, sl, d) @ params["wo"]
             # Megatron MLP: column-parallel w1, row-parallel w2; the
             # _tp_copy/psum pair is the f/g conjugate operator pair

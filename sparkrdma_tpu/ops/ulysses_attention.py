@@ -28,6 +28,32 @@ from sparkrdma_tpu.ops.pallas_attention import flash_attention
 from sparkrdma_tpu.parallel.mesh import make_mesh
 
 
+def ulysses_shard_attention(q, k, v, axis: str, num_shards: int,
+                            causal: bool = False, use_flash: bool = True):
+    """The shard-local Ulysses schedule, for use INSIDE shard_map:
+    seq-gather / head-scatter ([B, s, H, D] -> [B, s*E, H/E, D]) via
+    one tiled ``all_to_all``, full-sequence attention per head group
+    (the Pallas flash kernel — differentiable through its custom VJP),
+    and the inverse exchange. Both :class:`UlyssesAttention` and the
+    training step's sp schedule call this one implementation."""
+    if num_shards > 1:
+        q, k, v = (
+            jax.lax.all_to_all(t, axis, split_axis=2, concat_axis=1,
+                               tiled=True)
+            for t in (q, k, v)
+        )
+    if use_flash:
+        out = flash_attention(q, k, v, causal=causal)
+    else:
+        from sparkrdma_tpu.ops.ring_attention import reference_attention
+
+        out = reference_attention(q, k, v, causal=causal)
+    if num_shards > 1:
+        out = jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                 tiled=True)
+    return out
+
+
 class UlyssesAttention:
     """Compile-once all-to-all sequence-parallel attention."""
 
@@ -45,41 +71,9 @@ class UlyssesAttention:
         spec = P(None, axis, None, None)  # sharded on sequence
 
         def shard_fn(q, k, v):
-            # local [B, S/E, H, D] -> all_to_all over heads:
-            # split H into E groups, gather full sequence per group
-            def seq_to_heads(x):
-                # [B, s, H, D] -> [B, s, E, H/E, D] -> a2a on E
-                b, s, h, d = x.shape
-                x = x.reshape(b, s, e, h // e, d)
-                # move the exchange dim to front for tiled all_to_all
-                x = jnp.moveaxis(x, 2, 0)  # [E, B, s, H/E, d]
-                x = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
-                # received: [E, B, s, H/E, d] where dim 0 is now seq blocks
-                x = jnp.moveaxis(x, 0, 2)  # [B, s, E, H/E, d] -> seq major
-                b_, s_, e_, hh, d_ = x.shape
-                return jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(b_, e_ * s_, hh, d_)
-
-            def heads_to_seq(x):
-                # [B, S, H/E, D] -> back to [B, S/E, H, D]
-                b, s_full, hh, d = x.shape
-                s = s_full // e
-                x = x.reshape(b, e, s, hh, d)
-                x = jnp.moveaxis(x, 1, 0)  # [E, B, s, hh, d]
-                x = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
-                # dim 0 now indexes the head GROUP each peer owned —
-                # restore group-major head order
-                x = jnp.moveaxis(x, 0, 2)  # [B, s, E(group), hh, d]
-                b_, s_, e_, hh_, d_ = x.shape
-                return x.reshape(b_, s_, e_ * hh_, d_)
-
-            qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-            if use_flash:
-                out = flash_attention(qh, kh, vh, causal=causal)
-            else:
-                from sparkrdma_tpu.ops.ring_attention import reference_attention
-
-                out = reference_attention(qh, kh, vh, causal=causal)
-            return heads_to_seq(out)
+            return ulysses_shard_attention(
+                q, k, v, axis, e, causal=causal, use_flash=use_flash
+            )
 
         fn = shard_map(
             shard_fn,
