@@ -1,0 +1,77 @@
+"""Long-context training demo: sp-sharded transformer steps with both
+sequence-parallel schedules.
+
+Runs on the 8-device virtual CPU mesh (no TPU slice needed) and shows
+the two ways the framework trains across a sharded sequence axis:
+
+- ``attn="ring"``: kv blocks hop neighbour-to-neighbour (ppermute),
+  O(seq/sp) memory, autodiff through the online softmax;
+- ``attn="ulysses"``: two all-to-alls re-shard seq<->heads and the
+  full-sequence attention per head group runs through the Pallas flash
+  kernel, whose custom VJP keeps the backward at flash memory cost.
+
+Both schedules step the SAME initial parameters on the SAME batch and
+must agree with each other step for step (they compute identical math
+on different communication schedules).
+
+Usage: python examples/train_long_context.py [--steps 5]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from sparkrdma_tpu.models.transformer_step import (
+    TransformerStep,
+    init_params,
+    make_training_mesh,
+)
+
+
+def main(steps: int = 5) -> None:
+    mesh = make_training_mesh()
+    print(f"mesh: {dict(mesh.shape)}")
+    d_model, heads = 32, 4
+    params = init_params(d_model, n_heads=heads, d_hidden=64,
+                         tp=mesh.shape["tp"], seed=0)
+    rng = np.random.default_rng(0)
+    b, s = 4, 64  # sequence sharded over sp: each shard holds s/sp
+    x = jnp.asarray(rng.normal(size=(b, s, d_model)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(b, s, d_model)).astype(np.float32))
+
+    histories = {}
+    for schedule in ("ring", "ulysses"):
+        step = TransformerStep(mesh, n_heads=heads, lr=0.2, attn=schedule)
+        pl, xl, yl = step.place(params, x, y)
+        losses = []
+        for _ in range(steps):
+            loss, pl = step.step(pl, xl, yl)
+            losses.append(float(loss))
+        histories[schedule] = losses
+        print(f"{schedule:8s} losses: " + " ".join(f"{v:.5f}" for v in losses))
+
+    drift = max(
+        abs(a - b) for a, b in zip(histories["ring"], histories["ulysses"])
+    )
+    assert drift < 1e-4, f"schedules diverged: {drift}"
+    assert histories["ring"][-1] < histories["ring"][0], "loss did not drop"
+    print(f"schedules agree (max drift {drift:.2e}); loss decreased. demo OK")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    main(ap.parse_args().steps)
