@@ -22,6 +22,7 @@ W2 [H/tp, D] are tp-local (their grads psum over dp+sp only).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Dict, Optional, Tuple
 
@@ -262,16 +263,43 @@ class TransformerStep:
         }
         return pl, put(x, P("dp", "sp", None)), put(y, P("dp", "sp", None))
 
-    def step(self, params, x, y):
-        """(loss, new_params) — one SGD step, fully sharded."""
-        b, s, d = x.shape
-        h = params["w1"].shape[1]
+    def _get_step_fn(self, b, s, d, h):
         key = (b, s, d, h)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._build(b, s, d, h)
             self._cache[key] = fn
-        return fn(params, x, y)
+        return fn
+
+    def step(self, params, x, y):
+        """(loss, new_params) — one SGD step, fully sharded."""
+        b, s, d = x.shape
+        return self._get_step_fn(b, s, d, params["w1"].shape[1])(params, x, y)
+
+    def run_steps(self, params, x, y, n_steps: int):
+        """(final_loss, new_params) after ``n_steps`` SGD steps with the
+        WHOLE loop inside one executable (DESIGN.md §4: compile-once is
+        the SVC pattern — even inter-step collective scheduling is
+        compiled, and a K-step run costs one dispatch)."""
+        b, s, d = x.shape
+        h = params["w1"].shape[1]
+        key = (b, s, d, h, "loop")
+        loop = self._cache.get(key)
+        if loop is None:
+            step_fn = self._get_step_fn(b, s, d, h)
+
+            @functools.partial(jax.jit, static_argnums=(3,))
+            def loop(params, x, y, n):
+                def body(_, carry):
+                    _, p = carry
+                    return step_fn(p, x, y)
+
+                return jax.lax.fori_loop(
+                    0, n, body, (jnp.float32(0.0), params)
+                )
+
+            self._cache[key] = loop
+        return loop(params, x, y, n_steps)
 
 
 def reference_step(params, x, y, n_heads: int, lr: float):

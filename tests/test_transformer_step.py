@@ -91,3 +91,22 @@ def test_ulysses_rejects_indivisible_heads_over_sp():
         pytest.skip("needs sp >= 2")
     with pytest.raises(ValueError):
         TransformerStep(mesh, n_heads=3, attn="ulysses")
+
+
+def test_run_steps_loop_matches_stepwise():
+    """The whole-loop-in-one-jit runner must produce exactly the same
+    trajectory as repeated step() calls."""
+    mesh = make_training_mesh()
+    params = init_params(16, n_heads=4, d_hidden=32, tp=mesh.shape["tp"], seed=2)
+    x, y = _data(seed=2)
+    step = TransformerStep(mesh, n_heads=4, lr=0.1, attn="ulysses")
+    pl, xl, yl = step.place(params, x, y)
+    l1, p1 = step.step(pl, xl, yl)
+    l2, p2 = step.step(p1, xl, yl)
+    l_loop, p_loop = step.run_steps(pl, xl, yl, 2)
+    np.testing.assert_allclose(float(l_loop), float(l2), rtol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_loop[k]), np.asarray(p2[k]), rtol=1e-6, atol=1e-8,
+            err_msg=f"param {k}",
+        )
