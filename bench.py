@@ -367,7 +367,9 @@ def bench_device(jax) -> dict:
         r, rc = xfn(s_ ^ jnp.uint8(1), c_)  # xor defeats loop collapsing
         return (r, rc)
 
-    ems = _chained_ms(jax, jnp, ex_step, (slab, counts), 8, 72)
+    # long chain: per-step is sub-ms, so a short chain's difference
+    # drowns in dispatch jitter (observed 27-309 GB/s run-to-run)
+    ems = _chained_ms(jax, jnp, ex_step, (slab, counts), 32, 288)
     out["exchange_loopback_gbps"] = round(block / (ems / 1e3) / 1e9, 3)
     return out
 
