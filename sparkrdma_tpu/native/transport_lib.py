@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
 import threading
 from typing import Optional
@@ -123,3 +124,13 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+def toolchain_available() -> bool:
+    """True when the native plane is *buildable* here: g++ on PATH or a
+    prebuilt .so already cached. Distinct from ``available()``, which
+    also returns False when the build itself fails — tests must gate
+    their skip on THIS so a transport.cpp compile breakage fails
+    loudly instead of silently skipping. Cheap (no build triggered),
+    so safe to call at pytest collection time."""
+    return shutil.which("g++") is not None or os.path.exists(_SO)

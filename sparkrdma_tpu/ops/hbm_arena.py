@@ -291,6 +291,7 @@ class DeviceBufferManager:
         # host-RAM tier cap; overflow cascades to disk (§7.3(4) tier 3)
         self.max_host_bytes = max_host_bytes
         self._spill_dir = spill_dir
+        self._run_token = os.urandom(4).hex()
         self._stacks: Dict[int, _AllocatorStack] = {}
         self._handles: Dict[int, DeviceBuffer] = {}
         self._next_handle = 1
@@ -325,8 +326,11 @@ class DeviceBufferManager:
             buf.last_use = self._use_clock
 
     def _disk_path(self, handle: int) -> str:
+        # pid + per-manager random token: two executor processes on one
+        # host (the deployment model) must never collide on a spill
+        # name — id(self) alone is just a heap address both can share
         d = self._spill_dir or tempfile.gettempdir()
-        return f"{d}/hbm-spill-{id(self)}-{handle}.bin"
+        return f"{d}/hbm-spill-{os.getpid()}-{self._run_token}-{handle}.bin"
 
     def _pin(self, handle: int) -> None:
         with self._lock:
@@ -439,9 +443,15 @@ class DeviceBufferManager:
                 victim.spill_to_host()
                 continue
             with self._lock:
+                # Any pin held by another thread counts as transient
+                # contention worth waiting on — including a climber
+                # mid-restore whose budget is already charged
+                # (_reserve_for_restore) while its ``array`` is still
+                # None until jax.device_put returns (seconds for large
+                # slabs). Requiring device residency here raised
+                # MemoryError on a healthy pool during that window.
                 foreign_pins = any(
-                    (b := self._handles.get(h)) is not None
-                    and b.array is not None
+                    self._handles.get(h) is not None
                     and any(t != me for t in self._pin_threads.get(h, ()))
                     for h in self._pins
                 ) or self._allocating > 0

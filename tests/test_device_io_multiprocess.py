@@ -15,7 +15,7 @@ import os
 import numpy as np
 import pytest
 
-from sparkrdma_tpu.native.transport_lib import available as native_available
+from sparkrdma_tpu.native.transport_lib import toolchain_available
 from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
@@ -56,7 +56,7 @@ def _publisher_main(conf_dict, q_out, q_in):
 @pytest.mark.parametrize(
     "transport",
     ["python", pytest.param("native", marks=pytest.mark.skipif(
-        not native_available(), reason="native transport unavailable"))],
+        not toolchain_available(), reason="no g++ toolchain"))],
 )
 def test_cross_process_device_block_shuffle(transport):
     conf = TpuShuffleConf({"tpu.shuffle.transport": transport})

@@ -11,6 +11,10 @@ this)."""
 import importlib.util
 import os
 
+import pytest
+
+from sparkrdma_tpu.native.transport_lib import toolchain_available
+
 _spec = importlib.util.spec_from_file_location(
     "run_workloads",
     os.path.join(os.path.dirname(__file__), "..", "benchmarks", "run_workloads.py"),
@@ -31,6 +35,9 @@ def test_e2e_terasort_python_transport():
     assert m["hbm_spill_count"] == 0
 
 
+# gate on the TOOLCHAIN, not available(): a transport.cpp compile
+# breakage must fail this test, not skip it
+@pytest.mark.skipif(not toolchain_available(), reason="no g++ toolchain")
 def test_e2e_terasort_native_transport():
     run_workloads.bench_e2e_terasort(0.002, "native", reducers=4, executors=2)
     rec = run_workloads.RECORDS[-1]
