@@ -16,7 +16,9 @@ partition block is one pooled registered buffer whose
 from __future__ import annotations
 
 import logging
+import queue
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -115,7 +117,16 @@ class DeviceShuffleIO:
         ``dtype`` types the staged slabs (host-side reinterpret; see
         ``DeviceBufferManager.stage_view``) so device consumers read
         keys, not bytes. Returns pid -> list of DeviceBuffers (caller
-        frees)."""
+        frees).
+
+        ``timeout_s`` is ONE deadline for the whole fetch (the
+        reference's future-timeout wrapper semantics,
+        RdmaShuffleFetcherIterator.scala:108-122) — not a per-block
+        allowance, so one slow peer costs at most one timeout, never
+        ``n_blocks ×``. Arrived buffers stage in COMPLETION order while
+        slower reads are still in flight: staging (the expensive
+        host->HBM transfer on this rig) overlaps the waiting instead of
+        serializing behind issue order."""
         mgr = self._manager
         conf = mgr.conf
         if timeout_s is None:
@@ -134,9 +145,14 @@ class DeviceShuffleIO:
         # completion listener: the buffer returns to the pool only once
         # the transport is provably done writing into it (completion or
         # channel latch) — never on a timeout racing a late payload.
-        pending: List[Tuple[PartitionLocation, object, threading.Event, list]] = []
+        pending: List[Optional[Tuple]] = []
+        # completion-order wake-ups: every read completion (success or
+        # failure) posts its pending index here, so the caller stages
+        # whatever arrived FIRST and learns of failures immediately
+        # rather than when issue order reaches them
+        arrivals: "queue.Queue[int]" = queue.Queue()
 
-        def start_read(loc, reg):
+        def start_read(idx, loc, reg):
             done = threading.Event()
             errbox: list = []
             lock = threading.Lock()
@@ -154,6 +170,9 @@ class DeviceShuffleIO:
                         owner["recycled"] = True
                 if recycle:
                     mgr.buffer_manager.put(reg)
+                # duplicate posts are harmless: the arrival loop skips
+                # indices it has already consumed
+                arrivals.put(idx)
 
             def abandon_or_reclaim():
                 """Caller gives up: recycle now if the read already
@@ -195,14 +214,37 @@ class DeviceShuffleIO:
                     out.setdefault(loc.partition_id, []).append(dev)
                     continue
                 reg = mgr.buffer_manager.get(loc.block.length)
-                pending.append(start_read(loc, reg))
+                pending.append(start_read(len(pending), loc, reg))
 
-            for i, (loc, reg, done, errbox, _abandon) in enumerate(pending):
-                ok = done.wait(timeout_s)
-                if not ok or errbox:
-                    err = errbox[0] if errbox else TimeoutError("fetch timed out")
+            deadline = time.monotonic() + timeout_s
+            remaining = {i for i, e in enumerate(pending) if e is not None}
+            while remaining:
+                budget = deadline - time.monotonic()
+                try:
+                    if budget > 0:
+                        idx = arrivals.get(timeout=budget)
+                    else:
+                        # the deadline bounds the WAITING, not the
+                        # consumption of reads that already landed:
+                        # staging time (host->HBM transfers) may have
+                        # eaten the budget while completions queued up —
+                        # drain those without blocking before failing
+                        idx = arrivals.get_nowait()
+                except queue.Empty:
+                    # deadline spent with reads still outstanding
+                    slow = pending[next(iter(remaining))][0]
                     raise FetchFailedError(
-                        loc.manager_id, shuffle_id, -1, loc.partition_id, str(err)
+                        slow.manager_id, shuffle_id, -1, slow.partition_id,
+                        f"fetch deadline ({timeout_s:.1f}s) exceeded with "
+                        f"{len(remaining)} block(s) outstanding",
+                    )
+                if idx not in remaining:
+                    continue  # duplicate completion post
+                loc, reg, done, errbox, _abandon = pending[idx]
+                if errbox:
+                    raise FetchFailedError(
+                        loc.manager_id, shuffle_id, -1, loc.partition_id,
+                        str(errbox[0]),
                     )
                 # registered buffer -> HBM directly (one DMA, no pad
                 # program: the pooled source spans a full slab class);
@@ -211,7 +253,8 @@ class DeviceShuffleIO:
                 # for host sources
                 dev = self._dev.stage_view(reg.view, loc.block.length, dtype)
                 mgr.buffer_manager.put(reg)  # pooled reuse, not a cold free
-                pending[i] = None
+                pending[idx] = None
+                remaining.discard(idx)
                 out.setdefault(loc.partition_id, []).append(dev)
             return out
         except Exception:

@@ -170,6 +170,116 @@ def test_fetch_fault_surfaces_and_leaks_nothing(cluster, monkeypatch):
         io1.stop()
 
 
+def test_fetch_deadline_is_total_not_per_block(cluster, monkeypatch):
+    """One slow peer costs at most ONE timeout: ``timeout_s`` is a
+    deadline for the whole fetch (RdmaShuffleFetcherIterator.scala:
+    108-122 semantics), so wall stays ~timeout_s even with every
+    remote block wedged — not n_blocks x timeout_s."""
+    import threading
+    import time as _time
+
+    from sparkrdma_tpu.shuffle.errors import FetchFailedError
+    from sparkrdma_tpu.transport.channel import TpuChannel
+
+    conf, driver, ex0, ex1 = cluster
+    handle = BaseShuffleHandle(
+        shuffle_id=11, num_maps=2, partitioner=HashPartitioner(4)
+    )
+    driver.register_shuffle(handle)
+    io0, io1 = DeviceShuffleIO(ex0), DeviceShuffleIO(ex1)
+    rng = np.random.default_rng(7)
+    timers = []
+    try:
+        io0.publish_device_blocks(
+            11, {p: rng.integers(0, 256, 5000, np.uint8) for p in range(4)}
+        )
+        io1.publish_device_blocks(
+            11, {p: rng.integers(0, 256, 5000, np.uint8) for p in range(4)}
+        )
+
+        def wedged(self, listener, dst_views, blocks):
+            # every remote read "completes" far beyond the deadline
+            t = threading.Timer(30.0, lambda: listener.on_success(None))
+            t.daemon = True
+            timers.append(t)
+            t.start()
+
+        monkeypatch.setattr(TpuChannel, "read_in_queue", wedged)
+        t0 = _time.perf_counter()
+        with pytest.raises(FetchFailedError, match="deadline"):
+            io0.fetch_device_blocks(11, 0, 4, timeout_s=1.5)
+        wall = _time.perf_counter() - t0
+        # 4 wedged blocks: per-block waits would take ~6 s; one shared
+        # deadline takes ~1.5 s
+        assert wall < 4.0, f"fetch wall {wall:.1f}s — deadline not shared"
+        assert io0.device_buffers.in_use_bytes == 0
+    finally:
+        for t in timers:
+            t.cancel()
+        io0.stop()
+        io1.stop()
+
+
+def test_fetch_stages_in_arrival_order(cluster, monkeypatch):
+    """A delayed block must not hold up the staging of blocks that
+    already arrived: staging is completion-driven, so the slow block
+    stages LAST regardless of issue order."""
+    import threading
+
+    from sparkrdma_tpu.transport.channel import TpuChannel
+
+    conf, driver, ex0, ex1 = cluster
+    handle = BaseShuffleHandle(
+        shuffle_id=12, num_maps=1, partitioner=HashPartitioner(4)
+    )
+    driver.register_shuffle(handle)
+    io0, io1 = DeviceShuffleIO(ex0), DeviceShuffleIO(ex1)
+    rng = np.random.default_rng(9)
+    slow_len = 7777  # unique length marks the delayed block
+    try:
+        # remote publisher: partition 0 (issued FIRST) is the slow one
+        io1.publish_device_blocks(
+            12,
+            {
+                0: rng.integers(0, 256, slow_len, np.uint8),
+                **{p: rng.integers(0, 256, 5000, np.uint8) for p in (1, 2, 3)},
+            },
+        )
+        original = TpuChannel.read_in_queue
+
+        def delaying(self, listener, dst_views, blocks):
+            if blocks[0][2] == slow_len:
+                t = threading.Timer(
+                    0.8, lambda: original(self, listener, dst_views, blocks)
+                )
+                t.daemon = True
+                t.start()
+                return
+            return original(self, listener, dst_views, blocks)
+
+        monkeypatch.setattr(TpuChannel, "read_in_queue", delaying)
+        staged_lens = []
+        real_stage = io0.device_buffers.stage_view
+
+        def recording(view, valid_len=None, dtype=np.uint8):
+            staged_lens.append(valid_len)
+            return real_stage(view, valid_len, dtype)
+
+        monkeypatch.setattr(io0.device_buffers, "stage_view", recording)
+        got = io0.fetch_device_blocks(12, 0, 4, timeout_s=30)
+        assert sum(len(b) for b in got.values()) == 4
+        assert staged_lens[-1] == slow_len, (
+            f"slow block staged at position {staged_lens.index(slow_len)} "
+            f"of {len(staged_lens)} — staging followed issue order"
+        )
+        for bufs in got.values():
+            for b in bufs:
+                b.free()
+    finally:
+        io0.stop()
+        io1.stop()
+
+
 def test_unpublish_releases_registered_buffers(cluster):
     conf, driver, ex0, ex1 = cluster
     handle = BaseShuffleHandle(shuffle_id=2, num_maps=1, partitioner=HashPartitioner(1))
