@@ -34,6 +34,7 @@ Two transfer schedules are provided:
 from __future__ import annotations
 
 import math
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -106,9 +107,52 @@ class ExchangeProgram:
         self._all_to_all_cache = {}
         self._ring_cache = {}
         # transfer accounting (reference: pool/read stats at stop,
-        # RdmaBufferManager.java:131-141, RdmaShuffleReaderStats)
+        # RdmaBufferManager.java:131-141, RdmaShuffleReaderStats).
+        # Aggregates for back-compat; per-schedule detail in
+        # ``self.stats`` counts BOTH directions plus wall time per
+        # step, so schedule comparisons (a2a vs ring) can cite real
+        # transfer counters, not send-side capacity alone.
         self.exchanges = 0
         self.bytes_moved = 0
+        self.stats = {
+            label: {
+                "exchanges": 0,
+                "bytes_sent": 0,            # bucket capacity dispatched
+                "bytes_received": 0,        # bucket capacity landed
+                "bytes_received_valid": 0,  # sum of recv length prefixes
+                "time_s": 0.0,              # wall incl. device sync
+            }
+            for label in ("a2a", "ring")
+        }
+
+    def _account(self, label: str, send, recv, rcounts, t0: float):
+        """Block on the step's outputs and record both directions.
+
+        Blocking is what makes the wall time a *step* time (dispatch
+        alone is meaningless through an async runtime); callers of the
+        host-level entry points consume the results immediately, so
+        the sync costs them nothing extra. The valid-byte count reads
+        the int32 length-prefix lane only (tiny), never the payload."""
+        recv = jax.block_until_ready(recv)
+        rcounts = jax.block_until_ready(rcounts)
+        dt = time.perf_counter() - t0
+        cap = send.size * jnp.dtype(send.dtype).itemsize
+        if getattr(rcounts, "is_fully_addressable", True):
+            valid = int(np.asarray(rcounts).sum())
+        else:  # multi-host: only this process's shards are readable
+            valid = int(
+                sum(np.asarray(s.data).sum() for s in rcounts.addressable_shards)
+            )
+        s = self.stats[label]
+        s["exchanges"] += 1
+        s["bytes_sent"] += cap
+        # measured from the landed array, independently of the send side
+        s["bytes_received"] += recv.size * jnp.dtype(recv.dtype).itemsize
+        s["bytes_received_valid"] += valid
+        s["time_s"] += dt
+        self.exchanges += 1
+        self.bytes_moved += cap
+        return recv, rcounts
 
     # -- schedule 1: XLA-native dense all-to-all ---------------------------
     def _build_all_to_all(self, rows: int, block: int, dtype) -> "jax.stages.Wrapped":
@@ -159,9 +203,9 @@ class ExchangeProgram:
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         send = jax.device_put(send, sharding)
         counts = jax.device_put(counts, sharding)
-        self.exchanges += 1
-        self.bytes_moved += send.size * jnp.dtype(send.dtype).itemsize
-        return fn(send, counts)
+        t0 = time.perf_counter()
+        recv, rcounts = fn(send, counts)
+        return self._account("a2a", send, recv, rcounts, t0)
 
     # -- schedule 2: staged ring (ppermute) --------------------------------
     def _build_ring(self, block: int, dtype) -> "jax.stages.Wrapped":
@@ -228,6 +272,6 @@ class ExchangeProgram:
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         send = jax.device_put(send, sharding)
         counts = jax.device_put(counts, sharding)
-        self.exchanges += 1
-        self.bytes_moved += send.size * jnp.dtype(send.dtype).itemsize
-        return fn(send, counts)
+        t0 = time.perf_counter()
+        recv, rcounts = fn(send, counts)
+        return self._account("ring", send, recv, rcounts, t0)
