@@ -154,6 +154,24 @@ class ExchangeProgram:
         self.bytes_moved += cap
         return recv, rcounts
 
+    def _placed(self, send, counts):
+        """Lay host arrays out over the mesh; pass device arrays through.
+
+        A non-fully-addressable ``jax.Array`` is the multi-host path:
+        no single process can materialize (or device_put) the full
+        global slab, so the caller builds it from process-local shards
+        (``jax.make_array_from_process_local_data``) and this must not
+        touch it. Fully-addressable arrays still go through device_put
+        so a committed single-device array (any prior jit's output)
+        gets re-placed onto the mesh instead of crashing the shard_map
+        with an incompatible-devices error."""
+        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
+        if not (isinstance(send, jax.Array) and not send.is_fully_addressable):
+            send = jax.device_put(send, sharding)
+        if not (isinstance(counts, jax.Array) and not counts.is_fully_addressable):
+            counts = jax.device_put(counts, sharding)
+        return send, counts
+
     # -- schedule 1: XLA-native dense all-to-all ---------------------------
     def _build_all_to_all(self, rows: int, block: int, dtype) -> "jax.stages.Wrapped":
         axes = self.axes
@@ -200,9 +218,7 @@ class ExchangeProgram:
         """
         rows = send.shape[0] // self.num_shards
         fn = self.program_for(rows, send.shape[1], send.dtype)
-        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
-        send = jax.device_put(send, sharding)
-        counts = jax.device_put(counts, sharding)
+        send, counts = self._placed(send, counts)
         t0 = time.perf_counter()
         recv, rcounts = fn(send, counts)
         return self._account("a2a", send, recv, rcounts, t0)
@@ -269,9 +285,7 @@ class ExchangeProgram:
         if fn is None:
             fn = self._build_ring(send.shape[1], send.dtype)
             self._ring_cache[key] = fn
-        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
-        send = jax.device_put(send, sharding)
-        counts = jax.device_put(counts, sharding)
+        send, counts = self._placed(send, counts)
         t0 = time.perf_counter()
         recv, rcounts = fn(send, counts)
         return self._account("ring", send, recv, rcounts, t0)
