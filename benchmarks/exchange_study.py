@@ -230,7 +230,7 @@ def _spawn_child(args, devcount: int):
         env=env,
         cwd=ROOT,
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,  # surfaced in errors when a child dies
         text=True,
     )
 
@@ -269,9 +269,11 @@ def main() -> None:
     single = []
     for e, slices in meshes:
         p = _spawn_child(["--child", str(e), str(slices), blocks, str(reps)], e)
-        out, _ = p.communicate(timeout=1200)
+        out, err = p.communicate(timeout=1200)
         if p.returncode != 0:
-            raise RuntimeError(f"child (e={e}, slices={slices}) rc={p.returncode}")
+            raise RuntimeError(
+                f"child (e={e}, slices={slices}) rc={p.returncode}:\n{err[-2000:]}"
+            )
         single.extend(_result_line(out))
         print(f"mesh e={e} slices={slices}: done", file=sys.stderr)
 
@@ -281,11 +283,13 @@ def main() -> None:
         _spawn_child(["--dist-child", str(pid), "2", str(dist_block), str(dist_reps)], 4)
         for pid in range(2)
     ]
-    outs = [p.communicate(timeout=1200)[0] for p in procs]
+    results = [p.communicate(timeout=1200) for p in procs]
     for pid, p in enumerate(procs):
         if p.returncode != 0:
-            raise RuntimeError(f"dist child {pid} rc={p.returncode}")
-    dist = _result_line(outs[0])
+            raise RuntimeError(
+                f"dist child {pid} rc={p.returncode}:\n{results[pid][1][-2000:]}"
+            )
+    dist = _result_line(results[0][0])
     print("distributed 2-process run: done", file=sys.stderr)
 
     # schedule comparison at a glance: ring/a2a step-time ratio per config

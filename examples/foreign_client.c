@@ -1,0 +1,426 @@
+/* foreign_client — a C-only shuffle endpoint on the native wire.
+ *
+ * Proof that the framework's transport boundary is language-neutral
+ * the way the reference's DiSNI C ABI is (reference pom.xml:67-81:
+ * any JVM can consume libdisni; here any language that can open a TCP
+ * socket can be a full shuffle peer). This client implements the wire
+ * of sparkrdma_tpu/transport/wire.py + rpc.py from scratch — no
+ * Python, no framework code — and against a live Python driver +
+ * executor it:
+ *
+ *   1. HELLOs the driver and introduces itself (ManagerHello RPC),
+ *   2. PUBLISHES a partition of its own registered memory
+ *      (PublishPartitionLocations, num_map_outputs=1) which Python
+ *      reducers then fetch with one-sided READs served by THIS file,
+ *   3. FETCHES the locations of a Python-published shuffle and pulls
+ *      the real bytes with a one-sided READ_REQ.
+ *
+ * Frames (all big-endian; see transport.cpp:20-31):
+ *   SEND      = op(1) payload_len(4) payload        -- RPC segments
+ *   READ_REQ  = op(1) req_id(8) n(4) n x [mkey(4) addr(8) len(4)]
+ *   READ_RESP = op(1) req_id(8) total_len(8) payload
+ *   READ_ERR  = op(1) req_id(8) msg_len(4) msg
+ *   HELLO     = op(1) word(4)=(kind<<24)|port id_len(2) executor_id
+ *   GOODBYE   = op(1)
+ * RPC segment = msg_type(4) payload_len(4) payload  (rpc.py SEG_HEADER)
+ *   PUBLISH(0) payload = is_last(1) shuffle(4) partition(4) nmaps(4) locs
+ *   FETCH(1)   payload = manager_id shuffle(4) start(4) end(4)
+ *   MHELLO(2)  payload = manager_id
+ *   manager_id = hlen(2) host port(4) idlen(2) executor_id
+ *   location   = manager_id partition(4) addr(8) len(4) mkey(4)
+ *
+ * Usage: foreign_client <driver_host> <driver_port> <fetch_shuffle>
+ *                       <publish_shuffle> <out_path>
+ * Prints READY after the listener is up, FETCHED_OK <n> after the
+ * remote bytes are on disk, and serves READs until stdin closes.
+ */
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define OP_SEND 1
+#define OP_READ_REQ 2
+#define OP_READ_RESP 3
+#define OP_READ_ERR 4
+#define OP_HELLO 5
+#define OP_GOODBYE 6
+
+#define MSG_PUBLISH 0
+#define MSG_FETCH 1
+#define MSG_MHELLO 2
+
+#define MY_ID "c-client-0"
+#define MY_MKEY 1u
+#define PATTERN_LEN (64 * 1024)
+#define MAX_FDS 32
+#define MAX_LOCS 64
+
+static uint8_t pattern[PATTERN_LEN];
+
+/* ---------- byte order ---------- */
+static void st16(uint8_t *p, uint16_t v) { p[0] = v >> 8; p[1] = v; }
+static void st32(uint8_t *p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+static void st64(uint8_t *p, uint64_t v) { st32(p, v >> 32); st32(p + 4, (uint32_t)v); }
+static uint16_t ld16(const uint8_t *p) { return ((uint16_t)p[0] << 8) | p[1]; }
+static uint32_t ld32(const uint8_t *p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | p[3];
+}
+static uint64_t ld64(const uint8_t *p) {
+  return ((uint64_t)ld32(p) << 32) | ld32(p + 4);
+}
+
+/* ---------- io ---------- */
+static int read_full(int fd, void *buf, size_t n) {
+  uint8_t *p = buf;
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r == 0) return -1;               /* peer closed */
+    if (r < 0) { if (errno == EINTR) continue; return -1; }
+    p += r; n -= (size_t)r;
+  }
+  return 0;
+}
+static int write_full(int fd, const void *buf, size_t n) {
+  const uint8_t *p = buf;
+  while (n) {
+    ssize_t r = write(fd, p, n);
+    if (r < 0) { if (errno == EINTR) continue; return -1; }
+    p += r; n -= (size_t)r;
+  }
+  return 0;
+}
+
+static int dial(const char *host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &a.sin_addr) != 1 ||
+      connect(fd, (struct sockaddr *)&a, sizeof a) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+/* ---------- frame builders ---------- */
+static int send_hello(int fd, int kind, int my_port) {
+  uint8_t h[1 + 4 + 2 + sizeof(MY_ID) - 1];
+  h[0] = OP_HELLO;
+  st32(h + 1, ((uint32_t)kind << 24) | ((uint32_t)my_port & 0xFFFF));
+  st16(h + 5, sizeof(MY_ID) - 1);
+  memcpy(h + 7, MY_ID, sizeof(MY_ID) - 1);
+  return write_full(fd, h, sizeof h);
+}
+
+/* manager_id of THIS client into buf; returns length */
+static size_t put_mid(uint8_t *b, const char *host, int port) {
+  size_t hl = strlen(host), il = sizeof(MY_ID) - 1, o = 0;
+  st16(b + o, (uint16_t)hl); o += 2;
+  memcpy(b + o, host, hl); o += hl;
+  st32(b + o, (uint32_t)port); o += 4;
+  st16(b + o, (uint16_t)il); o += 2;
+  memcpy(b + o, MY_ID, il); o += il;
+  return o;
+}
+
+/* wrap one RPC segment in a SEND frame and ship it */
+static int send_rpc(int fd, int msg_type, const uint8_t *payload, size_t n) {
+  uint8_t hdr[1 + 4 + 4 + 4];
+  hdr[0] = OP_SEND;
+  st32(hdr + 1, (uint32_t)(8 + n));      /* SEND payload = segment */
+  st32(hdr + 5, (uint32_t)msg_type);     /* SEG_HEADER msg_type */
+  st32(hdr + 9, (uint32_t)n);            /* SEG_HEADER payload_len */
+  if (write_full(fd, hdr, sizeof hdr)) return -1;
+  return write_full(fd, payload, n);
+}
+
+/* ---------- parsed location of a fetched block ---------- */
+typedef struct {
+  char host[128];
+  int port;
+  int partition;
+  uint64_t addr;
+  uint32_t len;
+  uint32_t mkey;
+} Loc;
+
+static Loc locs[MAX_LOCS];
+static int nlocs = 0;
+static int fetch_done = 0; /* saw is_last publish for fetch_shuffle */
+
+/* parse PUBLISH segment payload; collect locations for want_shuffle */
+static void parse_publish(const uint8_t *p, size_t n, int want_shuffle) {
+  if (n < 13) return;
+  int is_last = p[0];
+  int shuffle = (int)ld32(p + 1);
+  size_t o = 13; /* skip is_last, shuffle, partition, num_map_outputs */
+  while (o + 2 <= n && nlocs < MAX_LOCS) {
+    uint16_t hl = ld16(p + o); o += 2;
+    if (o + hl + 4 + 2 > n) break;
+    Loc *L = &locs[nlocs];
+    size_t cl = hl < sizeof L->host - 1 ? hl : sizeof L->host - 1;
+    memcpy(L->host, p + o, cl); L->host[cl] = 0; o += hl;
+    L->port = (int)ld32(p + o); o += 4;
+    uint16_t il = ld16(p + o); o += 2 + il; /* skip executor id */
+    if (o + 4 + 16 > n) break;
+    L->partition = (int)ld32(p + o); o += 4;
+    L->addr = ld64(p + o); o += 8;
+    L->len = ld32(p + o); o += 4;
+    L->mkey = ld32(p + o); o += 4;
+    if (shuffle == want_shuffle) nlocs++;
+  }
+  if (shuffle == want_shuffle && is_last) fetch_done = 1;
+}
+
+/* serve one READ_REQ arriving on fd out of our registered pattern */
+static int serve_read(int fd) {
+  uint8_t h[12];
+  if (read_full(fd, h, 12)) return -1;
+  uint64_t req_id = ld64(h);
+  uint32_t n = ld32(h + 8);
+  if (n > 64) return -1;
+  uint8_t blocks[64 * 16];
+  if (read_full(fd, blocks, (size_t)n * 16)) return -1;
+  uint64_t total = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t mkey = ld32(blocks + i * 16);
+    uint64_t addr = ld64(blocks + i * 16 + 4);
+    uint32_t len = ld32(blocks + i * 16 + 12);
+    /* two-sided check: addr + len can wrap uint64 */
+    if (mkey != MY_MKEY || addr > PATTERN_LEN || len > PATTERN_LEN - addr) {
+      const char *msg = "bad mkey/bounds";
+      uint8_t e[13];
+      e[0] = OP_READ_ERR;
+      st64(e + 1, req_id);
+      st32(e + 9, (uint32_t)strlen(msg));
+      if (write_full(fd, e, 13) || write_full(fd, msg, strlen(msg)))
+        return -1;
+      return 0;
+    }
+    total += len;
+  }
+  uint8_t r[17];
+  r[0] = OP_READ_RESP;
+  st64(r + 1, req_id);
+  st64(r + 9, total);
+  if (write_full(fd, r, 17)) return -1;
+  for (uint32_t i = 0; i < n; i++) {
+    uint64_t addr = ld64(blocks + i * 16 + 4);
+    uint32_t len = ld32(blocks + i * 16 + 12);
+    if (write_full(fd, pattern + addr, len)) return -1;
+  }
+  return 0;
+}
+
+/* consume one frame from fd; returns -1 to close the connection */
+static int handle_frame(int fd, int fetch_shuffle) {
+  uint8_t op;
+  if (read_full(fd, &op, 1)) return -1;
+  switch (op) {
+    case OP_HELLO: {
+      uint8_t h[6];
+      if (read_full(fd, h, 6)) return -1;
+      uint16_t il = ld16(h + 4);
+      uint8_t id[512];
+      if (il > sizeof id || read_full(fd, id, il)) return -1;
+      return 0;
+    }
+    case OP_SEND: {
+      uint8_t l4[4];
+      if (read_full(fd, l4, 4)) return -1;
+      uint32_t len = ld32(l4);
+      if (len > (1u << 22)) return -1;
+      uint8_t *seg = malloc(len);
+      if (!seg || read_full(fd, seg, len)) { free(seg); return -1; }
+      if (len >= 8) {
+        uint32_t t = ld32(seg), pl = ld32(seg + 4);
+        if (pl <= len - 8 && t == MSG_PUBLISH)
+          parse_publish(seg + 8, pl, fetch_shuffle);
+        /* MSG_ANNOUNCE and others: membership gossip, ignored */
+      }
+      free(seg);
+      return 0;
+    }
+    case OP_READ_REQ:
+    case 9: /* READ_REQ2: same layout; we always stream (wire.py:31-35) */
+      return serve_read(fd);
+    case OP_GOODBYE:
+      return -1;
+    default:
+      fprintf(stderr, "foreign_client: unexpected op %d\n", op);
+      return -1;
+  }
+}
+
+/* pull every fetched location's bytes into out, partition-ordered */
+static int pull_blocks(const char *out_path, int my_port) {
+  FILE *out = fopen(out_path, "wb");
+  if (!out) return -1;
+  uint64_t total = 0;
+  /* partitions ascending so the file is deterministic; a partition
+   * may carry SEVERAL locations (one per map output) — consume the
+   * minimum-partition unconsumed entry until none remain */
+  for (;;) {
+    int next = -1;
+    for (int i = 0; i < nlocs; i++)
+      if (locs[i].partition >= 0 &&
+          (next == -1 || locs[i].partition < locs[next].partition))
+        next = i;
+    if (next == -1) break;
+    Loc *L = &locs[next];
+    int fd = dial(L->host, L->port);
+    if (fd < 0) { fclose(out); return -1; }
+    if (send_hello(fd, 1 /* data */, my_port)) { close(fd); fclose(out); return -1; }
+    uint8_t rq[13 + 16];
+    rq[0] = OP_READ_REQ;
+    st64(rq + 1, 42);
+    st32(rq + 9, 1);
+    st32(rq + 13, L->mkey);
+    st64(rq + 17, L->addr);
+    st32(rq + 25, L->len);
+    if (write_full(fd, rq, sizeof rq)) { close(fd); fclose(out); return -1; }
+    uint8_t rh[17];
+    if (read_full(fd, rh, 17) || rh[0] != OP_READ_RESP) {
+      close(fd); fclose(out); return -1;
+    }
+    uint64_t got = ld64(rh + 9);
+    uint8_t *body = malloc(got);
+    if (!body || read_full(fd, body, got)) { free(body); close(fd); fclose(out); return -1; }
+    fwrite(body, 1, got, out);
+    total += got;
+    free(body);
+    uint8_t bye = OP_GOODBYE;
+    write_full(fd, &bye, 1);
+    close(fd);
+    L->partition = -1;            /* consumed */
+  }
+  fclose(out);
+  printf("FETCHED_OK %llu\n", (unsigned long long)total);
+  fflush(stdout);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 6) {
+    fprintf(stderr,
+            "usage: %s driver_host driver_port fetch_shuffle "
+            "publish_shuffle out_path\n", argv[0]);
+    return 2;
+  }
+  const char *driver_host = argv[1];
+  int driver_port = atoi(argv[2]);
+  int fetch_shuffle = atoi(argv[3]);
+  int publish_shuffle = atoi(argv[4]);
+  const char *out_path = argv[5];
+  for (int i = 0; i < PATTERN_LEN; i++) pattern[i] = (uint8_t)(i * 31 + 7);
+
+  /* listener: the driver connects BACK here for announces + replies,
+   * and Python reducers connect here to READ our published block */
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind(lfd, (struct sockaddr *)&a, sizeof a) || listen(lfd, 16)) {
+    perror("listen");
+    return 1;
+  }
+  socklen_t alen = sizeof a;
+  getsockname(lfd, (struct sockaddr *)&a, &alen);
+  int my_port = ntohs(a.sin_port);
+
+  int dfd = dial(driver_host, driver_port);
+  if (dfd < 0) { perror("dial driver"); return 1; }
+  if (send_hello(dfd, 0 /* rpc */, my_port)) return 1;
+
+  uint8_t buf[1024];
+  size_t n = put_mid(buf, "127.0.0.1", my_port); /* ManagerHello */
+  if (send_rpc(dfd, MSG_MHELLO, buf, n)) return 1;
+
+  /* publish partition 0 of our registered pattern (writer publish:
+   * partition_id sentinel -1, one map output -> completes the barrier) */
+  uint8_t pub[1024];
+  size_t o = 0;
+  pub[o++] = 1;                      /* is_last */
+  st32(pub + o, (uint32_t)publish_shuffle); o += 4;
+  st32(pub + o, (uint32_t)-1); o += 4;
+  st32(pub + o, 1); o += 4;          /* num_map_outputs */
+  o += put_mid(pub + o, "127.0.0.1", my_port);
+  st32(pub + o, 0); o += 4;          /* partition_id */
+  st64(pub + o, 0); o += 8;          /* addr */
+  st32(pub + o, PATTERN_LEN); o += 4;
+  st32(pub + o, MY_MKEY); o += 4;
+  if (send_rpc(dfd, MSG_PUBLISH, pub, o)) return 1;
+
+  /* request the Python-published shuffle's locations */
+  o = put_mid(buf, "127.0.0.1", my_port);
+  st32(buf + o, (uint32_t)fetch_shuffle); o += 4;
+  st32(buf + o, 0); o += 4;
+  st32(buf + o, 1); o += 4;          /* [0, 1) */
+  if (send_rpc(dfd, MSG_FETCH, buf, o)) return 1;
+
+  printf("READY %d\n", my_port);
+  fflush(stdout);
+
+  struct pollfd fds[MAX_FDS];
+  int nfds = 3;
+  fds[0].fd = 0;   fds[0].events = POLLIN; /* stdin EOF = shutdown */
+  fds[1].fd = lfd; fds[1].events = POLLIN;
+  fds[2].fd = dfd; fds[2].events = POLLIN;
+  int pulled = 0;
+  for (;;) {
+    if (poll(fds, (nfds_t)nfds, 1000) < 0) {
+      if (errno == EINTR) continue;
+      return 1;
+    }
+    if (fetch_done && !pulled) {
+      pulled = 1;
+      if (pull_blocks(out_path, my_port)) {
+        fprintf(stderr, "foreign_client: pull failed\n");
+        return 1;
+      }
+    }
+    for (int i = 0; i < nfds; i++) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      if (fds[i].fd == 0) {
+        char c;
+        if (read(0, &c, 1) <= 0) return 0;   /* orchestrator done */
+      } else if (fds[i].fd == lfd) {
+        int cfd = accept(lfd, NULL, NULL);
+        if (cfd >= 0 && nfds < MAX_FDS) {
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          fds[nfds].fd = cfd;
+          fds[nfds].events = POLLIN;
+          nfds++;
+        } else if (cfd >= 0) {
+          close(cfd);
+        }
+      } else {
+        if (handle_frame(fds[i].fd, fetch_shuffle)) {
+          close(fds[i].fd);
+          fds[i] = fds[nfds - 1];
+          nfds--;
+          i--;
+        }
+      }
+    }
+  }
+}

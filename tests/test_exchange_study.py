@@ -8,10 +8,6 @@ accounting) that no single-process test can reach."""
 import importlib.util
 import json
 import os
-import subprocess
-import sys
-
-import pytest
 
 _spec = importlib.util.spec_from_file_location(
     "exchange_study",
