@@ -283,7 +283,13 @@ def main() -> None:
         _spawn_child(["--dist-child", str(pid), "2", str(dist_block), str(dist_reps)], 4)
         for pid in range(2)
     ]
-    results = [p.communicate(timeout=1200) for p in procs]
+    # drain both children CONCURRENTLY: they form one jax.distributed
+    # pair, so blocking on child 0 while child 1 fills its piped stderr
+    # (gloo chatter can exceed the pipe buffer) would deadlock the run
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(len(procs)) as tp:
+        results = list(tp.map(lambda p: p.communicate(timeout=1200), procs))
     for pid, p in enumerate(procs):
         if p.returncode != 0:
             raise RuntimeError(

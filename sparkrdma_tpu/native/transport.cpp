@@ -41,6 +41,8 @@
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/types.h>
@@ -142,6 +144,13 @@ struct OutBuf {
   const uint8_t* ext = nullptr;
   uint64_t ext_len = 0;
   uint32_t pin_mkey = 0;
+  // kernel-side zero-copy: when sf_fd >= 0 the bytes leave via
+  // sendfile(socket <- backing file) — ZERO userspace copies on the
+  // serving side (vs one for ext, two for data). ext stays set as the
+  // memory fallback at the same pos when sendfile errors. The fd is
+  // owned by this OutBuf (closed on completion or conn failure).
+  int sf_fd = -1;
+  uint64_t sf_off = 0;
 };
 
 struct PendingRead {
@@ -153,6 +162,12 @@ struct PendingRead {
   // block pread placement) and for re-posting a plain READ_REQ when a
   // READ_FILE answer turns out not to be readable from here
   std::vector<std::array<uint64_t, 3>> blocks;
+  // mapped delivery (srt_post_read_mapped): no caller dst — same-host
+  // blocks come back as mmap records (completion aux=1), streamed
+  // fallback lands in `owned` (malloc'd here, ownership passes to the
+  // completion payload, aux=0)
+  bool mapped = false;
+  uint8_t* owned = nullptr;
 };
 
 // incremental frame-parser states
@@ -172,6 +187,12 @@ struct Conn {
   bool hello_done = false;       // inbound conns announce themselves first
   bool outbound = false;
   bool down = false;
+  // loopback peers skip the sendfile serve path: measured on this rig,
+  // loopback sendfile moves ~18% SLOWER than a userspace send (the
+  // kernel page-pinning dance buys nothing without a DMA-capable NIC);
+  // real remote peers get sendfile's zero-copy. Node::force_sendfile
+  // overrides for tests/benches of the mechanism itself.
+  bool peer_loopback = false;
   std::deque<OutBuf> outq;
   bool want_write = false;
 
@@ -210,6 +231,7 @@ struct Command {
   uint8_t* dst = nullptr;
   uint64_t expected = 0;
   std::vector<std::array<uint64_t, 3>> blocks;
+  bool mapped = false;  // READ: mapped delivery requested
 };
 
 // one advertised backing file: path + offset + the registration-time
@@ -249,6 +271,8 @@ struct FileTask {
   uint8_t* dst = nullptr;
   std::vector<uint64_t> lens;
   std::vector<FileRef> files;
+  bool mapped = false;           // mmap instead of pread
+  std::vector<uint8_t> records;  // mapped result: n x 32B (ptr,len,base,maplen)
 };
 
 struct Node {
@@ -298,6 +322,13 @@ struct Node {
   // from Python for tests and the bench harness)
   std::atomic<uint64_t> stat_file_reads{0};
   std::atomic<uint64_t> stat_streamed_reads{0};
+  // client knob: 0 forces plain READ_REQ (streamed) even when the peer
+  // could answer READ_FILE — used to exercise/bench the remote path on
+  // a single host. Mapped reads always probe the file path.
+  std::atomic<int> file_fastpath{1};
+  // server knob: serve file-backed regions via sendfile even to
+  // loopback peers (tests/benches of the mechanism; see Conn comment)
+  std::atomic<int> force_sendfile{0};
 
   std::mutex cq_mu;
   std::condition_variable cq_cv;
@@ -316,7 +347,12 @@ struct Node {
   // its task is with the worker, so a dying Conn cannot free it
   // mid-pread and the destination keepalive stays owned until a
   // completion is posted.
-  std::thread file_worker;
+  // Striped: several workers drain the task queue concurrently. On
+  // rigs with spare kernel-side parallelism (this box: nproc=1 yet
+  // 2-thread pread measures ~1.5x one thread) concurrent read groups
+  // overlap their page-cache copies — the thread-pool analogue of the
+  // reference posting WR lists on multiple QPs (RdmaChannel.java:54-56).
+  std::vector<std::thread> file_workers;
   std::mutex ft_mu;
   std::condition_variable ft_cv;
   std::deque<FileTask> ftq;
@@ -386,6 +422,7 @@ void fail_conn(Node* n, Conn* c) {
   c->down = true;
   // fail every outstanding one-sided READ on this channel
   for (auto& kv : c->reads) {
+    if (kv.second.owned) free(kv.second.owned);  // fallback blob undelivered
     Completion comp{};
     comp.kind = COMP_READ_DONE;
     comp.status = ST_ERR;
@@ -397,6 +434,7 @@ void fail_conn(Node* n, Conn* c) {
   // ...and every queued-but-unflushed send, so no listener is orphaned
   // (the latch invariant of the Python channel, channel.py _latch_error)
   for (auto& ob : c->outq) {
+    if (ob.sf_fd >= 0) close(ob.sf_fd);
     if (ob.ext) unpin_region(n, ob.pin_mkey);
     if (ob.wr_id && ob.last_of_wr) {
       Completion comp{};
@@ -454,6 +492,22 @@ void flush_out(Node* n, Conn* c) {
     OutBuf& ob = c->outq.front();
     const uint8_t* base = ob.ext ? ob.ext : ob.data.data();
     const size_t size = ob.ext ? (size_t)ob.ext_len : ob.data.size();
+    // kernel path first: sendfile moves page-cache pages into the
+    // socket with no userspace copy. Any failure (EINVAL on an exotic
+    // fs, etc.) degrades to the pinned-memory send at the same pos —
+    // the file and the region hold identical bytes by construction.
+    while (ob.sf_fd >= 0 && ob.pos < size) {
+      off_t off = (off_t)(ob.sf_off + ob.pos);
+      ssize_t w = sendfile(c->fd, ob.sf_fd, &off, size - ob.pos);
+      if (w > 0) {
+        ob.pos += (size_t)w;
+      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return;  // EPOLLOUT stays armed
+      } else {
+        close(ob.sf_fd);
+        ob.sf_fd = -1;  // degrade to memory send below
+      }
+    }
     while (ob.pos < size) {
       ssize_t w = send(c->fd, base + ob.pos, size - ob.pos, MSG_NOSIGNAL);
       if (w > 0) {
@@ -465,6 +519,7 @@ void flush_out(Node* n, Conn* c) {
         return;
       }
     }
+    if (ob.sf_fd >= 0) close(ob.sf_fd);
     if (ob.ext) unpin_region(n, ob.pin_mkey);
     if (ob.wr_id && ob.last_of_wr) {
       Completion comp{};
@@ -492,6 +547,10 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
                 const std::vector<std::array<uint64_t, 3>>& blocks) {
   uint64_t total = 0;
   std::vector<std::pair<const uint8_t*, uint64_t>> views;
+  // per-block backing file for the sendfile path: (path, abs offset,
+  // identity) when the region is file-backed, else empty path
+  struct SfRef { std::string path; uint64_t off, dev, ino, size, mtime_ns; };
+  std::vector<SfRef> sf;
   {
     std::lock_guard<std::mutex> g(n->reg_mu);
     for (auto& b : blocks) {
@@ -510,6 +569,13 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
         return;
       }
       views.emplace_back(it->second.ptr + b[1], b[2]);
+      if (it->second.file_backed) {
+        sf.push_back({it->second.path, it->second.file_off + b[1],
+                      it->second.file_dev, it->second.file_ino,
+                      it->second.file_size, it->second.file_mtime_ns});
+      } else {
+        sf.push_back({std::string(), 0, 0, 0, 0, 0});
+      }
       total += b[2];
     }
     // pin while still under the lock so no dereg can slip between
@@ -531,6 +597,25 @@ void serve_read(Node* n, Conn* c, uint64_t req_id,
     ob.ext = views[i].first;
     ob.ext_len = views[i].second;
     ob.pin_mkey = (uint32_t)blocks[i][0];
+    if (!sf[i].path.empty() &&
+        (!c->peer_loopback || n->force_sendfile.load())) {
+      // file-backed region: serve by sendfile (zero userspace copies)
+      // when the path still names the registered file; the pinned
+      // memory view above remains the in-place fallback either way.
+      // Loopback peers keep the userspace send — see Conn::peer_loopback.
+      int fd = open(sf[i].path.c_str(), O_RDONLY);
+      if (fd >= 0) {
+        struct stat fst;
+        if (fstat(fd, &fst) == 0 &&
+            stat_matches(fst, sf[i].dev, sf[i].ino, sf[i].size,
+                         sf[i].mtime_ns)) {
+          ob.sf_fd = fd;
+          ob.sf_off = sf[i].off;
+        } else {
+          close(fd);
+        }
+      }
+    }
     c->outq.push_back(std::move(ob));
   }
   if (!c->want_write && !blocks.empty()) {
@@ -626,7 +711,48 @@ void send_read_frame(Node* n, Conn* c, uint64_t req_id,
 // server captured at REGISTRATION, so neither a stale cached fd nor a
 // shuffle file unlinked and rewritten at the same path (a task
 // re-attempt) can serve wrong bytes; mismatch falls back to streaming.
+// mapped delivery: mmap each block's file range instead of pread-ing
+// it — ZERO copies on the client too; the consumer reads page-cache
+// pages in place (the true same-host DMA analogue). Record layout per
+// block: user_ptr(8) len(8) map_base(8) map_len(8), all host-endian —
+// this never crosses the wire, it goes straight to the local caller.
+bool do_file_task_mapped(FileTask& t) {
+  size_t page = (size_t)sysconf(_SC_PAGESIZE);
+  std::vector<std::array<uint64_t, 4>> maps;
+  bool ok = true;
+  for (size_t i = 0; i < t.files.size() && ok; i++) {
+    const FileRef& f = t.files[i];
+    int fd = open(f.path.c_str(), O_RDONLY);
+    if (fd < 0) { ok = false; break; }
+    struct stat fst;
+    if (fstat(fd, &fst) != 0 ||
+        !stat_matches(fst, f.dev, f.ino, f.size, f.mtime_ns)) {
+      close(fd);
+      ok = false;
+      break;
+    }
+    uint64_t aligned = f.off & ~(uint64_t)(page - 1);
+    uint64_t delta = f.off - aligned;
+    uint64_t map_len = t.lens[i] + delta;
+    void* base = mmap(nullptr, (size_t)map_len, PROT_READ, MAP_SHARED, fd,
+                      (off_t)aligned);
+    close(fd);  // the mapping keeps the inode alive
+    if (base == MAP_FAILED) { ok = false; break; }
+    maps.push_back({(uint64_t)base + delta, t.lens[i], (uint64_t)base,
+                    map_len});
+  }
+  if (!ok) {
+    for (auto& m : maps) munmap((void*)m[2], (size_t)m[3]);
+    return false;
+  }
+  t.records.resize(maps.size() * 32);
+  for (size_t i = 0; i < maps.size(); i++)
+    memcpy(t.records.data() + i * 32, maps[i].data(), 32);
+  return true;
+}
+
 bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
+  if (t.mapped) return do_file_task_mapped(t);
   uint64_t dst_off = 0;
   for (size_t i = 0; i < t.files.size(); i++) {
     uint64_t len = t.lens[i];
@@ -689,6 +815,7 @@ void file_worker_main(Node* n) {
     cmd.kind = ok ? Command::FILE_DONE : Command::FILE_FALLBACK;
     cmd.channel = t.channel;
     cmd.req_id = t.req_id;
+    cmd.data = std::move(t.records);  // mapped: mmap records for the CQ
     n->enqueue(std::move(cmd));
   }
   for (auto& kv : fd_cache) close(kv.second);
@@ -777,6 +904,28 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
           } else {
             c->cur_req = req;
             c->cur_read = &it->second;
+            if (it->second.mapped && !it->second.dst && total) {
+              // mapped request answered by streaming (remote peer or
+              // unbacked region): land in a malloc'd blob whose
+              // ownership passes to the completion payload
+              it->second.owned = (uint8_t*)malloc(total);
+              if (!it->second.owned) {
+                // allocation failure fails THIS read, not the process:
+                // drain the body to keep framing intact
+                Completion comp{};
+                comp.kind = COMP_READ_DONE;
+                comp.status = ST_ERR;
+                comp.channel = c->id;
+                comp.wr_id = it->second.wr_id;
+                n->post(comp);
+                c->reads.erase(it);
+                c->cur_read = nullptr;
+                c->drain_left = total;
+                c->st = RxState::READR_DRAIN;
+                break;
+              }
+              it->second.dst = it->second.owned;
+            }
             c->st = total ? RxState::READR_BODY : RxState::OP;
             if (!total) {
               n->stat_streamed_reads++;
@@ -860,6 +1009,14 @@ size_t ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
           comp.status = ST_OK;
           comp.channel = c->id;
           comp.wr_id = pr->wr_id;
+          if (pr->owned) {
+            // mapped request, streamed answer: deliver the blob
+            // (aux=0 -> contiguous copied bytes, receiver frees)
+            comp.payload = pr->owned;
+            comp.payload_len = pr->expected;
+            comp.aux = 0;
+            pr->owned = nullptr;
+          }
           n->post(comp);
           c->reads.erase(c->cur_req);
           c->cur_read = nullptr;
@@ -958,6 +1115,7 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         t.channel = c->id;
         t.req_id = c->cur_req;
         t.dst = it->second.dst;
+        t.mapped = it->second.mapped;
         for (auto& b : it->second.blocks) t.lens.push_back(b[2]);
         t.files = std::move(files);
         n->file_pending.emplace(std::make_pair(c->id, c->cur_req),
@@ -1009,6 +1167,14 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
     default:
       break;
   }
+}
+
+bool fd_peer_is_loopback(int fd) {
+  sockaddr_in a{};
+  socklen_t l = sizeof a;
+  if (getpeername(fd, (sockaddr*)&a, &l) != 0) return false;
+  return a.sin_family == AF_INET &&
+         (ntohl(a.sin_addr.s_addr) >> 24) == 127;
 }
 
 void loop_main(Node* n) {
@@ -1073,6 +1239,7 @@ void loop_main(Node* n) {
             if (it != n->conns.end()) c = it->second;
           }
           if (cmd.kind == Command::ADD_CONN && c) {
+            c->peer_loopback = fd_peer_is_loopback(c->fd);
             epoll_event ev{};
             ev.events = EPOLLIN;
             ev.data.ptr = c;
@@ -1103,11 +1270,15 @@ void loop_main(Node* n) {
               pr.dst = cmd.dst;
               pr.expected = cmd.expected;
               pr.blocks = cmd.blocks;
+              pr.mapped = cmd.mapped;
               c->reads.emplace(cmd.req_id, std::move(pr));
               // first try the same-host file path unless this channel
-              // already proved the peer's files unreachable
+              // already proved the peer's files unreachable (or the
+              // node knob forces streaming; mapped reads always probe)
               send_read_frame(n, c, cmd.req_id, cmd.blocks,
-                              c->files_ok != 0);
+                              c->files_ok != 0 &&
+                                  (cmd.mapped ||
+                                   n->file_fastpath.load() != 0));
             }
           } else if (cmd.kind == Command::CLOSE_CONN && c) {
             // flush what we can, then drop
@@ -1145,6 +1316,16 @@ void loop_main(Node* n) {
                 comp.status = ST_OK;
                 comp.channel = cmd.channel;
                 comp.wr_id = pr.wr_id;
+                if (pr.mapped) {
+                  // aux=1: payload is n x 32B mmap records; receiver
+                  // owns the mappings (srt_unmap) and the record blob
+                  comp.aux = 1;
+                  comp.payload_len = cmd.data.size();
+                  if (!cmd.data.empty()) {
+                    comp.payload = malloc(cmd.data.size());
+                    memcpy(comp.payload, cmd.data.data(), cmd.data.size());
+                  }
+                }
                 n->post(comp);
               } else if (c && !c->down) {
                 // transient file failure: stream THIS read; the conn's
@@ -1173,6 +1354,7 @@ void loop_main(Node* n) {
           tune_socket(fd);
           Conn* c = new Conn();
           c->fd = fd;
+          c->peer_loopback = fd_peer_is_loopback(fd);
           {
             std::lock_guard<std::mutex> g(n->conn_mu);
             c->id = n->next_conn++;
@@ -1210,6 +1392,15 @@ void loop_main(Node* n) {
                 comp.status = ST_OK;
                 comp.channel = c->id;
                 comp.wr_id = pr->wr_id;
+                if (pr->owned) {
+                  // mapped request, streamed answer (same hand-off as
+                  // the ingest-path completion below): blob ownership
+                  // passes to the completion payload
+                  comp.payload = pr->owned;
+                  comp.payload_len = pr->expected;
+                  comp.aux = 0;
+                  pr->owned = nullptr;
+                }
                 n->post(comp);
                 c->reads.erase(c->cur_req);
                 c->cur_read = nullptr;
@@ -1322,7 +1513,7 @@ void* srt_node_create(const char* host, uint16_t base_port, int max_retries) {
     if (ufd >= 0) close(ufd);
   }
   n->loop = std::thread(loop_main, n);
-  n->file_worker = std::thread(file_worker_main, n);
+  n->file_workers.emplace_back(file_worker_main, n);
   return n;
 }
 
@@ -1507,6 +1698,10 @@ int srt_post_send(void* np, uint64_t channel, const void* data, uint64_t len,
   return 0;
 }
 
+// one process-wide READ request-id source shared by both post paths
+// (ids must be unique per connection; two counters could collide)
+std::atomic<uint64_t> g_next_req{1};
+
 // post a one-sided READ of n_blocks remote (mkey, addr, len) triples;
 // bytes stream straight into dst; READ_DONE(wr_id) on completion
 int srt_post_read(void* np, uint64_t channel, uint64_t wr_id, void* dst,
@@ -1518,8 +1713,7 @@ int srt_post_read(void* np, uint64_t channel, uint64_t wr_id, void* dst,
     blks[i] = {blocks[i * 3], blocks[i * 3 + 1], blocks[i * 3 + 2]};
     total += blocks[i * 3 + 2];
   }
-  static std::atomic<uint64_t> next_req{1};
-  uint64_t req_id = next_req.fetch_add(1);
+  uint64_t req_id = g_next_req.fetch_add(1);
   Command cmd;
   cmd.kind = Command::READ;
   cmd.channel = channel;
@@ -1530,6 +1724,63 @@ int srt_post_read(void* np, uint64_t channel, uint64_t wr_id, void* dst,
   cmd.blocks = std::move(blks);
   n->enqueue(std::move(cmd));
   return 0;
+}
+
+// post a one-sided READ with MAPPED delivery: no destination buffer.
+// Same-host file-backed blocks complete with aux=1 and a payload of
+// n x 32B host-endian records [user_ptr, len, map_base, map_len] — the
+// caller reads the bytes in place (zero copies end to end) and MUST
+// srt_unmap(map_base, map_len) each record, then srt_free_payload the
+// record blob. A streamed answer (remote peer / unbacked region)
+// completes with aux=0 and a malloc'd contiguous payload the caller
+// frees with srt_free_payload. Mappings outstanding at process exit
+// are reclaimed by the OS.
+int srt_post_read_mapped(void* np, uint64_t channel, uint64_t wr_id,
+                         const uint64_t* blocks, uint32_t n_blocks) {
+  Node* n = (Node*)np;
+  uint64_t total = 0;
+  std::vector<std::array<uint64_t, 3>> blks(n_blocks);
+  for (uint32_t i = 0; i < n_blocks; i++) {
+    blks[i] = {blocks[i * 3], blocks[i * 3 + 1], blocks[i * 3 + 2]};
+    total += blocks[i * 3 + 2];
+  }
+  uint64_t req_id = g_next_req.fetch_add(1);
+  Command cmd;
+  cmd.kind = Command::READ;
+  cmd.channel = channel;
+  cmd.wr_id = wr_id;
+  cmd.req_id = req_id;
+  cmd.dst = nullptr;
+  cmd.expected = total;
+  cmd.blocks = std::move(blks);
+  cmd.mapped = true;
+  n->enqueue(std::move(cmd));
+  return 0;
+}
+
+void srt_unmap(void* base, uint64_t len) { munmap(base, (size_t)len); }
+
+// 0 forces plain READ_REQ (streamed) for non-mapped reads — bench /
+// remote-path-simulation knob; 1 restores the default REQ2 probe
+void srt_set_file_fastpath(void* np, int on) {
+  ((Node*)np)->file_fastpath.store(on);
+}
+
+// serve file-backed regions via sendfile even to loopback peers
+// (tests/benches; loopback normally keeps the faster userspace send)
+void srt_set_force_sendfile(void* np, int on) {
+  ((Node*)np)->force_sendfile.store(on);
+}
+
+// grow the file-worker pool to k threads (never shrinks; clamped to
+// [1, 16]). Concurrent read groups then overlap their page-cache
+// copies — the QP-striping analogue (see Node::file_workers).
+void srt_set_file_workers(void* np, int k) {
+  Node* n = (Node*)np;
+  if (k < 1) k = 1;
+  if (k > 16) k = 16;
+  while ((int)n->file_workers.size() < k && !n->stopping.load())
+    n->file_workers.emplace_back(file_worker_main, n);
 }
 
 int srt_close_channel(void* np, uint64_t channel) {
@@ -1580,7 +1831,8 @@ void srt_node_stop(void* np) {
   // the worker drains queued tasks (their destination buffers stay
   // alive until this function returns), then exits on `stopping`
   n->ft_cv.notify_all();
-  if (n->file_worker.joinable()) n->file_worker.join();
+  for (auto& w : n->file_workers)
+    if (w.joinable()) w.join();
   close(n->listen_fd);
   {
     std::lock_guard<std::mutex> g(n->conn_mu);

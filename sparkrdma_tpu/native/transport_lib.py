@@ -110,6 +110,15 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
         ]
+        lib.srt_post_read_mapped.restype = ctypes.c_int
+        lib.srt_post_read_mapped.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
+        ]
+        lib.srt_unmap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.srt_set_file_fastpath.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.srt_set_file_workers.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.srt_set_force_sendfile.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.srt_close_channel.restype = ctypes.c_int
         lib.srt_close_channel.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.srt_poll_cq.restype = ctypes.c_int

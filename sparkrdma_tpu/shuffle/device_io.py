@@ -152,7 +152,56 @@ class DeviceShuffleIO:
         # rather than when issue order reaches them
         arrivals: "queue.Queue[int]" = queue.Queue()
 
-        def start_read(idx, loc, reg):
+        def start_read_mapped(idx, loc, ch):
+            """Mapped-delivery flavor (native transport): no pooled
+            destination buffer at all. Same-host blocks arrive as
+            zero-copy page-cache mappings; remote ones as one malloc'd
+            blob. Ownership dance mirrors start_read: whoever turns out
+            to be the last owner (caller or listener) releases."""
+            done = threading.Event()
+            errbox: list = []
+            box: dict = {}
+            lock = threading.Lock()
+            owner = {"who": "caller"}
+
+            def on_ok(delivery):
+                box["d"] = delivery
+                done.set()
+                with lock:
+                    release = (
+                        owner["who"] == "listener" and not owner.get("done")
+                    )
+                    if release:
+                        owner["done"] = True
+                if release and delivery is not None:
+                    delivery.release()
+                arrivals.put(idx)
+
+            def on_fail(e):
+                errbox.append(e)
+                done.set()
+                arrivals.put(idx)
+
+            def abandon_or_reclaim():
+                with lock:
+                    if done.is_set():
+                        completed = not owner.get("done")
+                        owner["done"] = True
+                    else:
+                        owner["who"] = "listener"
+                        completed = False
+                if completed:
+                    d = box.get("d")
+                    if d is not None:
+                        d.release()
+
+            ch.read_mapped_in_queue(
+                FnListener(on_ok, on_fail),
+                [(loc.block.mkey, loc.block.address, loc.block.length)],
+            )
+            return (loc, box, done, errbox, abandon_or_reclaim)
+
+        def start_read(idx, loc, reg, ch):
             done = threading.Event()
             errbox: list = []
             lock = threading.Lock()
@@ -186,7 +235,6 @@ class DeviceShuffleIO:
                 if completed:
                     mgr.buffer_manager.put(reg)
 
-            ch = mgr.get_channel_to(loc.manager_id, purpose="data")
             ch.read_in_queue(
                 FnListener(lambda _: on_done(), on_done),
                 [reg.view[: loc.block.length]],
@@ -213,8 +261,12 @@ class DeviceShuffleIO:
                     dev = self._dev.stage_view(view, loc.block.length, dtype)
                     out.setdefault(loc.partition_id, []).append(dev)
                     continue
-                reg = mgr.buffer_manager.get(loc.block.length)
-                pending.append(start_read(len(pending), loc, reg))
+                ch = mgr.get_channel_to(loc.manager_id, purpose="data")
+                if conf.mapped_fetch and hasattr(ch, "read_mapped_in_queue"):
+                    pending.append(start_read_mapped(len(pending), loc, ch))
+                else:
+                    reg = mgr.buffer_manager.get(loc.block.length)
+                    pending.append(start_read(len(pending), loc, reg, ch))
 
             deadline = time.monotonic() + timeout_s
             remaining = {i for i, e in enumerate(pending) if e is not None}
@@ -240,19 +292,30 @@ class DeviceShuffleIO:
                     )
                 if idx not in remaining:
                     continue  # duplicate completion post
-                loc, reg, done, errbox, _abandon = pending[idx]
+                loc, obj, done, errbox, _abandon = pending[idx]
                 if errbox:
                     raise FetchFailedError(
                         loc.manager_id, shuffle_id, -1, loc.partition_id,
                         str(errbox[0]),
                     )
-                # registered buffer -> HBM directly (one DMA, no pad
-                # program: the pooled source spans a full slab class);
-                # the buffer returns to the pool only after the
-                # transfer, which device_put completes synchronously
-                # for host sources
-                dev = self._dev.stage_view(reg.view, loc.block.length, dtype)
-                mgr.buffer_manager.put(reg)  # pooled reuse, not a cold free
+                if isinstance(obj, dict):
+                    # mapped delivery: stage straight from the page-cache
+                    # mapping (or fallback blob) — the socket/pread copy
+                    # of the buffer path never happened. stage_view
+                    # blocks until the device transfer completes, so
+                    # releasing the mapping right after is safe.
+                    d = obj["d"]
+                    view = d.views[0] if d.views else b""
+                    dev = self._dev.stage_view(view, loc.block.length, dtype)
+                    d.release()
+                else:
+                    # registered buffer -> HBM directly (one DMA, no pad
+                    # program: the pooled source spans a full slab
+                    # class); the buffer returns to the pool only after
+                    # the transfer, which device_put completes
+                    # synchronously for host sources
+                    dev = self._dev.stage_view(obj.view, loc.block.length, dtype)
+                    mgr.buffer_manager.put(obj)  # pooled reuse, not a cold free
                 pending[idx] = None
                 remaining.discard(idx)
                 out.setdefault(loc.partition_id, []).append(dev)

@@ -43,6 +43,40 @@ def _addr_of(view) -> int:
     return np.frombuffer(view, dtype=np.uint8).ctypes.data
 
 
+class MappedDelivery:
+    """Result of a mapped one-sided READ (``read_mapped_in_queue``).
+
+    ``views`` holds one read-only memoryview per requested block, in
+    request order. On the same-host fast path the views are mmap'd
+    page-cache windows of the peer's backing files — the bytes were
+    never copied anywhere; consumers read them in place (stage to the
+    device, checksum, parse) and then MUST call :meth:`release` to
+    drop the mappings. On the streamed fallback (remote peer, unbacked
+    region) the views slice one malloc'd blob that release() frees.
+    Either way: views are INVALID after release()."""
+
+    __slots__ = ("views", "mapped", "_free", "_released")
+
+    def __init__(self, views, mapped: bool, free_fn):
+        self.views = views
+        self.mapped = mapped  # True: zero-copy mmap; False: copied blob
+        self._free = free_fn
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self.views = []
+        self._free()
+
+    def __del__(self):  # leak guard: mappings must not outlive the GC
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
 class NativeProtectionDomain:
     """PD over the native region registry.
 
@@ -237,6 +271,22 @@ class NativeTpuChannel:
         if self._acquire_or_queue(permits, (permits, post)):
             post()
 
+    def read_mapped_in_queue(
+        self,
+        listener: CompletionListener,
+        blocks: List[Tuple[int, int, int]],
+    ) -> None:
+        """One-sided READ with mapped delivery: no destination buffer.
+        ``listener.on_success`` receives a :class:`MappedDelivery` —
+        same-host file-backed blocks arrive as zero-copy page-cache
+        mappings; anything else falls back to one streamed copy. The
+        listener owns the delivery and must release() it."""
+        permits = max(1, len(blocks))
+        wrapped = self._wrap_reclaim(listener, permits)
+        post = lambda: self._node._post_read_mapped(self, wrapped, blocks)
+        if self._acquire_or_queue(permits, (permits, post)):
+            post()
+
     @property
     def is_connected(self) -> bool:
         return not self._dead.is_set()
@@ -297,6 +347,17 @@ class NativeTpuNode:
         # outstanding work requests: wr_id -> (listener, keepalive)
         self._wrs: Dict[int, Tuple[CompletionListener, object]] = {}
         self._next_wr = 1
+        # mapped READs in flight: wr_id -> block lengths (for slicing a
+        # streamed-fallback blob back into per-block views)
+        self._mapped_wrs: Dict[int, List[int]] = {}
+
+        if not conf.file_fastpath:
+            # bench/remote-simulation knob: stream every non-mapped READ
+            lib.srt_set_file_fastpath(self._np, 0)
+        if conf.file_workers > 1:
+            lib.srt_set_file_workers(self._np, conf.file_workers)
+        if conf.force_sendfile:
+            lib.srt_set_force_sendfile(self._np, 1)
 
         self._stopped = threading.Event()
         self._cq_thread = threading.Thread(
@@ -404,6 +465,74 @@ class NativeTpuNode:
             self._np, ch.channel_id, wr, staging.ctypes.data, flat, len(blocks)
         )
 
+    def _post_read_mapped(self, ch, listener, blocks) -> None:
+        if ch._dead.is_set():
+            if listener:
+                listener.on_failure(ChannelError(f"channel {ch.peer_desc} is down"))
+            return
+        wr = self._alloc_wr(listener)
+        with self._lock:
+            # remember the block lengths so the completion can slice a
+            # streamed-fallback blob back into per-block views
+            self._mapped_wrs[wr] = [b[2] for b in blocks]
+        flat = (ctypes.c_uint64 * (3 * len(blocks)))()
+        for i, b in enumerate(blocks):
+            flat[3 * i], flat[3 * i + 1], flat[3 * i + 2] = b
+        self._lib.srt_post_read_mapped(
+            self._np, ch.channel_id, wr, flat, len(blocks)
+        )
+
+    def _mapped_delivery(self, c, lens) -> MappedDelivery:
+        """Build the delivery object for a mapped READ completion."""
+        lib = self._lib
+        if c.aux == 1:
+            # n x 32B host-endian records [user_ptr, len, base, map_len]
+            n = c.payload_len // 32 if c.payload else 0
+            rec = (
+                np.ctypeslib.as_array(
+                    ctypes.cast(c.payload, ctypes.POINTER(ctypes.c_uint64)),
+                    shape=(n * 4,),
+                ).reshape(n, 4).copy()
+                if n
+                else np.zeros((0, 4), np.uint64)
+            )
+            views = [
+                memoryview(
+                    (ctypes.c_ubyte * int(r[1])).from_address(int(r[0]))
+                ).cast("B").toreadonly()  # writes would SIGSEGV PROT_READ pages
+                for r in rec
+            ]
+
+            def free():
+                for r in rec:
+                    lib.srt_unmap(
+                        ctypes.c_void_p(int(r[2])), ctypes.c_uint64(int(r[3]))
+                    )
+
+            return MappedDelivery(views, True, free)
+        # aux == 0: contiguous copied blob; we take ownership (the poll
+        # loop's blanket free is skipped by nulling c.payload)
+        addr, total = c.payload, c.payload_len
+        c.payload = None
+        blob = (
+            memoryview((ctypes.c_ubyte * total).from_address(addr))
+            .cast("B")
+            .toreadonly()  # match the mmap path: views are read-only
+            if addr
+            else memoryview(b"")
+        )
+        views = []
+        off = 0
+        for ln in lens:
+            views.append(blob[off : off + ln])
+            off += ln
+
+        def free_blob(addr=addr):
+            if addr:
+                lib.srt_free_payload(ctypes.c_void_p(addr))
+
+        return MappedDelivery(views, False, free_blob)
+
     def _complete_wr(self, wr_id: int, payload, error: Optional[Exception]) -> None:
         with self._lock:
             entry = self._wrs.pop(wr_id, None)
@@ -479,8 +608,13 @@ class NativeTpuNode:
             self._complete_wr(c.wr_id, None, err)
             return
         if c.kind == tl.COMP_READ_DONE:
+            with self._lock:
+                lens = self._mapped_wrs.pop(c.wr_id, None)
             if c.status == tl.ST_OK:
-                self._complete_wr(c.wr_id, None, None)
+                payload = (
+                    self._mapped_delivery(c, lens) if lens is not None else None
+                )
+                self._complete_wr(c.wr_id, payload, None)
             elif c.status == tl.ST_REMOTE_ERR:
                 msg = (
                     ctypes.string_at(c.payload, c.payload_len).decode("utf-8")

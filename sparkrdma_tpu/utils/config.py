@@ -233,6 +233,41 @@ class TpuShuffleConf:
         raw = (self._conf.get(PREFIX + "transport", "python") or "python").lower()
         return raw if raw in ("python", "native") else "python"
 
+    @property
+    def file_fastpath(self) -> bool:
+        """Allow the native client's same-host READ_FILE fast path for
+        plain (buffer-destination) READs. Off forces every such READ
+        through the streamed socket path — the bench's remote-path
+        simulation knob. Mapped READs always probe the file path."""
+        return self._bool("fileFastPath", True)
+
+    @property
+    def force_sendfile(self) -> bool:
+        """Server-side: serve file-backed regions via sendfile even to
+        loopback peers. Normally loopback keeps the userspace send
+        (measured faster without a DMA NIC); tests and benches of the
+        sendfile mechanism itself enable this."""
+        return self._bool("forceSendfile", False)
+
+    @property
+    def file_workers(self) -> int:
+        """Same-host file-task worker threads in the native plane.
+        Concurrent read groups overlap their page-cache copies — the
+        analogue of the reference striping WR lists over multiple QPs
+        (RdmaChannel.java:54-56). Default 2: measured on the bench rig,
+        2 workers move ~1.5x one worker even at nproc=1 (kernel-side
+        parallelism); more shows no further gain there."""
+        return self._int("fileWorkers", 2, 1, 16)
+
+    @property
+    def mapped_fetch(self) -> bool:
+        """Use mapped delivery (zero-copy page-cache mmap on same-host
+        peers) for device-block fetches on the native transport. The
+        streamed fallback still lands in one malloc'd blob, so this is
+        never slower than the buffer path; off restores pooled
+        registered destination buffers."""
+        return self._bool("mappedFetch", True)
+
     # -- TPU device exchange plane (new; no reference analogue) -----------
     @property
     def exchange_bucket_min(self) -> int:

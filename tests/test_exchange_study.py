@@ -45,7 +45,12 @@ def test_two_process_distributed_exchange(monkeypatch):
         )
         for pid in range(2)
     ]
-    outs = [p.communicate(timeout=300)[0] for p in procs]
+    # concurrent drain: the ranks progress together, so a sequential
+    # communicate() could deadlock on a filled stderr pipe
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(2) as tp:
+        outs = [r[0] for r in tp.map(lambda p: p.communicate(timeout=300), procs)]
     assert all(p.returncode == 0 for p in procs), outs
     rec = exchange_study._result_line(outs[0])
     assert rec["verified"] and rec["e"] == 4 and rec["processes"] == 2
