@@ -162,25 +162,52 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
     ios = [DeviceShuffleIO(ex) for ex in execs]
     phases = {}
     try:
-        # --- map side: host sort + range split (Spark's role) ----------
-        t0 = time.perf_counter()
-        splits = []
-        for sh in shards:
-            local = np.sort(sh)
+        # --- map side + publish, pipelined per executor ----------------
+        # each executor's publish overlaps the next one's sort (the
+        # map-side analogue of the reduce-side fetch/merge overlap);
+        # busy times are informational, the wall is what counts
+        from concurrent.futures import ThreadPoolExecutor
+
+        t_sort_busy = [0.0] * executors
+        t_pub_busy = [0.0] * executors
+        keep0 = {}  # executor 0's sorted output, reused by the solo probe
+
+        def map_and_publish(i):
+            ts = time.perf_counter()
+            local = np.sort(shards[i])
             bounds = np.concatenate(
                 [[0], np.searchsorted(local, edges), [len(local)]]
             )
-            splits.append((local, bounds))
-        phases["map_sort_s"] = time.perf_counter() - t0
-
-        # --- publish into registered memory + driver locations ---------
-        t0 = time.perf_counter()
-        for io, (local, bounds) in zip(ios, splits):
-            io.publish_device_blocks(
+            tm = time.perf_counter()
+            t_sort_busy[i] = tm - ts
+            ios[i].publish_device_blocks(
                 99,
                 {r: local[bounds[r]: bounds[r + 1]] for r in range(reducers)},
             )
-        phases["publish_s"] = time.perf_counter() - t0
+            t_pub_busy[i] = time.perf_counter() - tm
+            if i == 0:
+                keep0["local"], keep0["bounds"] = local, bounds
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(executors) as tp:
+            list(tp.map(map_and_publish, range(executors)))
+        phases["map_publish_wall_s"] = time.perf_counter() - t0
+
+        # publish cost measured UNCONTENDED (solo re-publish of
+        # executor 0's retained sorted output to a throwaway shuffle
+        # id): busy timers under the pipelined phase inflate with CPU
+        # contention against the sorts (1-core rig) and wall-minus-busy
+        # arithmetic breaks on multi-core — a direct solo measurement
+        # is right on both topologies
+        local0, bounds0 = keep0["local"], keep0["bounds"]
+        ts = time.perf_counter()
+        ios[0].publish_device_blocks(
+            98, {r: local0[bounds0[r]: bounds0[r + 1]] for r in range(reducers)}
+        )
+        publish_solo = time.perf_counter() - ts
+        ios[0].unpublish(98)
+        keep0.clear()
+        del local0
 
         # --- reduce side: READ -> stage -> device merge ----------------
         # Blocks arrive STAGED AS uint32 (fetch dtype) — a uint8 slab
@@ -240,13 +267,44 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             )
         phases_compile = time.perf_counter() - t0
 
+        # impute the merge's ON-CHIP time: K chained device_sorts at
+        # the merge shape inside ONE executable, differenced against a
+        # 1-step chain — the only timing that survives the tunnel
+        # (bench.py methodology). The merge is the sort plus cheap
+        # elementwise masking, so this bounds its real compute from
+        # below; device_merge_busy_s minus this is tunnel dispatch +
+        # readback latency, MEASURED rather than asserted.
+        from functools import partial
+
+        from sparkrdma_tpu.ops.sort import device_sort as _dsort
+
+        @partial(jax.jit, static_argnums=(1,))
+        def sort_chain(v, k):
+            def body(i, v):
+                return _dsort(v ^ i.astype(jnp.uint32))
+
+            return jax.lax.fori_loop(0, k, body, v)
+
+        probe = jnp.zeros((executors * cls_hi,), jnp.uint32)
+        jax.block_until_ready(sort_chain(probe, 1))
+        jax.block_until_ready(sort_chain(probe, 9))
+
+        def _timed_chain(k, reps=3):
+            best = float("inf")
+            for _ in range(reps):
+                ts = time.perf_counter()
+                jax.block_until_ready(sort_chain(probe, k))
+                best = min(best, time.perf_counter() - ts)
+            return best
+
+        per_merge_on_chip = max((_timed_chain(9) - _timed_chain(1)) / 8, 0.0)
+        merge_on_chip_total = per_merge_on_chip * reducers
+
         # fetch/compute overlap (SURVEY §2.3): the next reducer's
         # READ + HBM staging runs on a worker thread while the device
         # merges the current one — the e2e exercises the same overlap
         # the fetcher gives record-plane readers. Phase timers count
         # BUSY time per plane; with overlap their sum exceeds wall.
-        from concurrent.futures import ThreadPoolExecutor
-
         t_fetch = t_merge = 0.0
 
         def fetch_one(r):
@@ -319,6 +377,7 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
                 max(0.0, t_fetch + t_merge - reduce_wall), 3
             ),
         }
+        extra_busy_raw = {"t_merge": t_merge}
         # live observability counters (pool allocs, read-path split,
         # fetch histograms, HBM budget/spills) into the artifact
         metrics = reducer_io.metrics_snapshot()
@@ -330,25 +389,178 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         driver.stop()
 
     total = sum(phases.values())
+    # tunnel-vs-framework attribution, measured not asserted:
+    #   framework = publish + fetch transport (bytes arriving in host
+    #     memory: RPC, one-sided READ, mmap/pread) — what this
+    #     framework ADDS over a plain sort pipeline;
+    #   compute   = host map sorts + the merge's imputed ON-CHIP time
+    #     (the work the baseline's np.sort also had to do);
+    #   tunnel    = host->HBM staging + merge dispatch/readback beyond
+    #     on-chip time — the rig's accelerator link, not framework.
+    ft = float(metrics.get("fetch_transport_s", 0.0))
+    fs = float(metrics.get("fetch_stage_s", 0.0))
+    tunnel_merge = max(extra_busy_raw["t_merge"] - merge_on_chip_total, 0.0)
+    # publish cost: the solo uncontended measurement scaled to all
+    # executors (see above). Busy timers from the pipelined phase stay
+    # in the table, labeled contended, for transparency.
+    publish_uncontended = publish_solo * executors
+    # reduce-side residual: wall not accounted to either plane's busy
+    # clock (scheduling gaps, Python orchestration)
+    reduce_residual = max(
+        phases["reduce_wall_s"]
+        - extra_busy["fetch_stage_busy_s"]
+        - extra_busy_raw["t_merge"],
+        0.0,
+    )
+    attribution = {
+        "compute_map_sort_busy_s": round(sum(t_sort_busy), 3),
+        "compute_merge_on_chip_s_imputed": round(merge_on_chip_total, 3),
+        "framework_publish_uncontended_s": round(publish_uncontended, 3),
+        "framework_publish_busy_s_contended": round(sum(t_pub_busy), 3),
+        "framework_fetch_transport_s": round(ft, 3),
+        "framework_reduce_residual_s": round(reduce_residual, 3),
+        "tunnel_fetch_stage_s": round(fs, 3),
+        "tunnel_merge_dispatch_readback_s": round(tunnel_merge, 3),
+    }
+    # the framework's OWN code (registration+publish+location RPC+READ
+    # transport+orchestration residual): what the reference's plugin
+    # adds over Spark's sort machinery — compare against
+    # host_sort_baseline_s
+    framework_attributable = publish_uncontended + ft + reduce_residual
+    # ex-tunnel comparison: RECONSTRUCTED bottom-up from measured
+    # non-tunnel components (subtracting overlapped busy clocks from a
+    # wall would double-count their overlap — fetch staging and merge
+    # dispatch run concurrently by design)
+    ex_tunnel_total = (
+        phases["map_publish_wall_s"]
+        + ft
+        + merge_on_chip_total
+        + reduce_residual
+    )
     report(
         "terasort_e2e", total,
         gb=round(n * 4 / (1 << 30), 3), transport=transport,
         reducers=reducers, executors=executors,
         host_sort_baseline_s=round(t_host, 3),
         vs_host_sort=round(t_host / total, 3),
+        vs_host_sort_ex_tunnel=round(t_host / ex_tunnel_total, 3),
+        framework_attributable_s=round(framework_attributable, 3),
+        attribution=attribution,
         compile_warm_s=round(phases_compile, 3),
         verified="count+sum+xor+sorted (on-device)",
         metrics=metrics,
         **extra_busy,
         note=(
-            "single-host rig: reduce_wall_s (and the overlapped "
-            "fetch_stage_busy_s / device_merge_busy_s it is built "
-            "from) is dominated by axon-tunnel dispatch+transfer "
-            "latency, not framework code (bench.py measures the "
-            "planes in isolation); the reference's 1.41x was "
-            "multi-node where shuffle crosses a real network"
+            "attribution: framework_attributable_s is the framework's "
+            "OWN code (uncontended publish + fetch transport + reduce "
+            "orchestration residual — the role the reference's plugin "
+            "plays over Spark's sort machinery); compute rows are work "
+            "the baseline also does; tunnel rows are MEASURED host<->"
+            "HBM staging and merge dispatch/readback beyond imputed "
+            "on-chip time. vs_host_sort_ex_tunnel compares against an "
+            "ex-tunnel wall RECONSTRUCTED from measured non-tunnel "
+            "components (map+publish wall, fetch transport, on-chip "
+            "merge, reduce residual) — subtracting overlapped busy "
+            "clocks from the wall would double-count their overlap"
         ),
         **{k: round(v, 3) for k, v in phases.items()},
+    )
+
+
+def bench_device_terasort_skew(scale: float):
+    """The adversarial TeraSort round (SURVEY §7.3(2)): zipf-skewed
+    keys concentrate mass in a few range partitions, so the static
+    bucket capacity overflows and the sorter retries with doubled
+    capacity (terasort.py capacity doubling). This workload makes that
+    strategy's cost a NUMBER next to the uniform round: extra
+    executions + a recompile per new capacity (cached within the
+    process and across runs via the persistent cache).
+
+    Overflow requires E > 1 (at E=1 every key lands in the one bucket,
+    which is sized to hold them all), so on a single-chip rig this
+    self-provisions an 8-virtual-device CPU mesh in a child process —
+    the dryrun_multichip strategy; the record is labeled CPU-only."""
+    import subprocess
+
+    import jax
+
+    from sparkrdma_tpu.models import TeraSorter
+    from sparkrdma_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) == 1 and not os.environ.get("_SRT_SKEW_CHILD"):
+        env = dict(os.environ)
+        env["_SRT_SKEW_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        kept = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            kept + ["--xla_force_host_platform_device_count=8"]
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--only", "skew", "--scale", str(scale)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"skew child failed:\n{proc.stderr[-2000:]}")
+        lines = [
+            l for l in proc.stdout.splitlines()
+            if '"terasort_device_skew"' in l
+        ]
+        if not lines:
+            raise RuntimeError(
+                "skew child exited 0 without a record line; stderr:\n"
+                + proc.stderr[-2000:]
+            )
+        rec = json.loads(lines[-1])
+        rec["platform"] = "cpu-8dev (overflow needs E>1; CPU-only timing)"
+        RECORDS.append(rec)
+        print(json.dumps(rec), flush=True)
+        return
+
+    n = int((1 << 24) * scale * 20)
+    rng = np.random.default_rng(0)
+    # zipf ranks mapped into the uint32 key space: heavy mass lands in
+    # the lowest-range partitions (~a>1.5 concentrates >70% of keys in
+    # the first percent of the key space)
+    ranks = rng.zipf(1.5, size=n)
+    keys = ((ranks % (1 << 16)) * 65536 + rng.integers(0, 65536, n)).astype(
+        np.uint32
+    )
+    sorter = TeraSorter(make_mesh())
+
+    out = sorter.sort(keys)  # warm: compiles base capacity AND retries
+    assert len(out) == n
+    doublings_warm = max(
+        0, int(np.log2(max(k[1] for k in sorter._step_cache)
+                       / min(k[1] for k in sorter._step_cache)))
+    ) if len(sorter._step_cache) > 1 else 0
+    t0 = time.perf_counter()
+    out = sorter.sort(keys)
+    dt = time.perf_counter() - t0
+    assert all(out[i] <= out[i + 1] for i in range(0, min(2000, n - 1)))
+
+    # uniform control at the same n, same process (executables warm)
+    uni = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    sorter.sort(uni)  # warm any uniform-shape executable
+    t0 = time.perf_counter()
+    sorter.sort(uni)
+    dt_uni = time.perf_counter() - t0
+    report(
+        "terasort_device_skew", dt,
+        keys=n, zipf_a=1.5,
+        capacity_doublings=doublings_warm,
+        uniform_control_s=round(dt_uni, 4),
+        skew_overhead_x=round(dt / dt_uni, 3) if dt_uni > 0 else None,
+        devices=len(jax.devices()),
+        note=(
+            "skew cost = overflow-retry executions at doubled bucket "
+            "capacity (static-shape strategy, SURVEY §7.3(2)); "
+            "recompiles amortized by the in-process step cache + "
+            "persistent compilation cache"
+        ),
     )
 
 
@@ -471,13 +683,41 @@ def bench_hashjoin(scale: float):
     report("hashjoin", dt, build=nb, probe=npr, rows_per_s=int(npr / dt))
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (the SVC amortization the
+    reference gets from stateful verb calls, RdmaChannel.java:185-192:
+    setup cost paid once per JOB, not per run). First run compiles and
+    persists; every later run of the same shapes loads in ~ms, so
+    compile_warm_s stops dominating small e2e runs."""
+    import jax
+
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # older jax: cache flags absent — run uncached
+        pass
+
+
 if __name__ == "__main__":
+    if os.environ.get("_SRT_SKEW_CHILD"):
+        # the axon platform plugin force-overrides JAX_PLATFORMS at
+        # import; pin the CPU device farm via config (conftest.py
+        # strategy) before any jax use
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    enable_compile_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=0.05)
     ap.add_argument("--transport", default="python", choices=["python", "native"])
     ap.add_argument(
         "--only", default=None,
-        choices=[None, "engine", "terasort", "e2e", "train",
+        choices=[None, "engine", "terasort", "skew", "e2e", "train",
                  "pagerank", "als", "join"],
     )
     ap.add_argument(
@@ -492,6 +732,7 @@ if __name__ == "__main__":
     runs = {
         "engine": lambda: bench_engine_terasort(args.scale, args.transport),
         "terasort": lambda: bench_device_terasort(args.scale),
+        "skew": lambda: bench_device_terasort_skew(args.scale),
         "train": lambda: bench_transformer_train(args.scale),
         "pagerank": lambda: bench_pagerank(args.scale),
         "als": lambda: bench_als(args.scale),

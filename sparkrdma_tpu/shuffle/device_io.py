@@ -54,6 +54,17 @@ class DeviceShuffleIO:
         # until unpublish — the serving side of one-sided READs)
         self._published: Dict[int, List] = {}
         self._lock = threading.Lock()
+        # fetch-phase accounting (tunnel-vs-framework attribution):
+        #   transport_s — waiting for bytes to ARRIVE in host memory
+        #     (RPC, one-sided READ, pread/mmap, sockets): framework.
+        #   stage_s — host -> HBM device transfers (jax.device_put via
+        #     stage_view): the accelerator link (on this rig, the axon
+        #     tunnel), NOT framework code.
+        self._fetch_stats = {
+            "fetch_transport_s": 0.0,
+            "fetch_stage_s": 0.0,
+            "fetch_bytes": 0,
+        }
 
     @property
     def device_buffers(self) -> DeviceBufferManager:
@@ -131,13 +142,23 @@ class DeviceShuffleIO:
         conf = mgr.conf
         if timeout_s is None:
             timeout_s = conf.fetch_location_timeout_ms / 1000.0
+        t_transport = t_stage = 0.0
+        n_bytes = 0
         future = mgr.fetch_remote_partition_locations(
             shuffle_id, start_partition, end_partition
         )
+        tw = time.perf_counter()
         try:
             locations: List[PartitionLocation] = future.result(timeout=timeout_s)
         except Exception as e:
             raise MetadataFetchFailedError(shuffle_id, start_partition, str(e))
+        finally:
+            # the location RPC is transport: bytes can't arrive before
+            # the driver answers where they are
+            t_transport += time.perf_counter() - tw
+            with self._lock:
+                self._fetch_stats["fetch_transport_s"] += t_transport
+            t_transport = 0.0
 
         out: Dict[int, List[DeviceBuffer]] = {}
         my_id = mgr.executor_id
@@ -258,7 +279,10 @@ class DeviceShuffleIO:
                     )
                     span = min(_size_class(loc.block.length), avail)
                     view = pd.resolve(loc.block.mkey, loc.block.address, span)
+                    ts = time.perf_counter()
                     dev = self._dev.stage_view(view, loc.block.length, dtype)
+                    t_stage += time.perf_counter() - ts
+                    n_bytes += loc.block.length
                     out.setdefault(loc.partition_id, []).append(dev)
                     continue
                 ch = mgr.get_channel_to(loc.manager_id, purpose="data")
@@ -272,6 +296,7 @@ class DeviceShuffleIO:
             remaining = {i for i, e in enumerate(pending) if e is not None}
             while remaining:
                 budget = deadline - time.monotonic()
+                tw = time.perf_counter()
                 try:
                     if budget > 0:
                         idx = arrivals.get(timeout=budget)
@@ -283,6 +308,11 @@ class DeviceShuffleIO:
                         # drain those without blocking before failing
                         idx = arrivals.get_nowait()
                 except queue.Empty:
+                    # the final (possibly full-budget) wait is transport
+                    # time too — without this the failure case records
+                    # near-zero transport for a fetch that spent its
+                    # whole wall waiting on it
+                    t_transport += time.perf_counter() - tw
                     # deadline spent with reads still outstanding
                     slow = pending[next(iter(remaining))][0]
                     raise FetchFailedError(
@@ -290,6 +320,7 @@ class DeviceShuffleIO:
                         f"fetch deadline ({timeout_s:.1f}s) exceeded with "
                         f"{len(remaining)} block(s) outstanding",
                     )
+                t_transport += time.perf_counter() - tw
                 if idx not in remaining:
                     continue  # duplicate completion post
                 loc, obj, done, errbox, _abandon = pending[idx]
@@ -298,6 +329,7 @@ class DeviceShuffleIO:
                         loc.manager_id, shuffle_id, -1, loc.partition_id,
                         str(errbox[0]),
                     )
+                ts = time.perf_counter()
                 if isinstance(obj, dict):
                     # mapped delivery: stage straight from the page-cache
                     # mapping (or fallback blob) — the socket/pread copy
@@ -316,6 +348,8 @@ class DeviceShuffleIO:
                     # synchronously for host sources
                     dev = self._dev.stage_view(obj.view, loc.block.length, dtype)
                     mgr.buffer_manager.put(obj)  # pooled reuse, not a cold free
+                t_stage += time.perf_counter() - ts
+                n_bytes += loc.block.length
                 pending[idx] = None
                 remaining.discard(idx)
                 out.setdefault(loc.partition_id, []).append(dev)
@@ -333,6 +367,11 @@ class DeviceShuffleIO:
                     continue
                 entry[4]()  # abandon_or_reclaim
             raise
+        finally:
+            with self._lock:
+                self._fetch_stats["fetch_transport_s"] += t_transport
+                self._fetch_stats["fetch_stage_s"] += t_stage
+                self._fetch_stats["fetch_bytes"] += n_bytes
 
     # ------------------------------------------------------------------
     def metrics_snapshot(self) -> dict:
@@ -345,6 +384,11 @@ class DeviceShuffleIO:
         snap["hbm_in_use_bytes"] = self._dev.in_use_bytes
         snap["hbm_spill_count"] = self._dev.spill_count
         snap["hbm_disk_spill_count"] = self._dev.disk_spill_count
+        with self._lock:
+            snap.update(
+                {k: round(v, 3) if isinstance(v, float) else v
+                 for k, v in self._fetch_stats.items()}
+            )
         return snap
 
     def unpublish(self, shuffle_id: int) -> None:

@@ -4,20 +4,38 @@ Mirrors the reference's missing-but-implied multi-node-without-a-cluster
 strategy (SURVEY.md §4): all sharding/collective tests run on
 ``--xla_force_host_platform_device_count=8`` CPU devices so CI needs no
 TPU slice.
+
+On-chip subset: ``SRT_TPU_TESTS=1 python -m pytest tests -m tpu -q``
+skips the CPU pin so the ``tpu``-marked tests (tests/test_on_chip.py)
+run against the REAL platform — closing the gap between "tests green
+on the CPU farm" and "works on hardware" without dragging the whole
+suite through the chip tunnel.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("SRT_TPU_TESTS"):
+    # real platform (TPU via the axon plugin); only `-m tpu` tests
+    # should be selected in this mode
+    import jax  # noqa: F401
+else:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-# The axon TPU plugin (this image's tunnel to the real chip) overrides
-# JAX_PLATFORMS at import time; pin the platform via jax.config too so
-# CI sharding tests always see the 8 virtual CPU devices.
-import jax  # noqa: E402
+    # The axon TPU plugin (this image's tunnel to the real chip) overrides
+    # JAX_PLATFORMS at import time; pin the platform via jax.config too so
+    # CI sharding tests always see the 8 virtual CPU devices.
+    import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: on-chip test (run with SRT_TPU_TESTS=1 python -m pytest -m tpu)",
+    )
