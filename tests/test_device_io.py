@@ -280,6 +280,85 @@ def test_fetch_stages_in_arrival_order(cluster, monkeypatch):
         io1.stop()
 
 
+def test_mapped_fetch_fault_releases_late_delivery(cluster, monkeypatch):
+    """Mapped-delivery ownership dance under failure: when one mapped
+    read fails and another's delivery arrives AFTER the caller has
+    abandoned the fetch, the listener (now the last owner) must
+    release the delivery — mappings must never outlive the fetch."""
+    import threading
+    import time as _time
+
+    from sparkrdma_tpu.shuffle.errors import FetchFailedError
+    from sparkrdma_tpu.transport.channel import ChannelError
+
+    conf, driver, ex0, ex1 = cluster
+    handle = BaseShuffleHandle(
+        shuffle_id=13, num_maps=1, partitioner=HashPartitioner(2)
+    )
+    driver.register_shuffle(handle)
+    io0, io1 = DeviceShuffleIO(ex0), DeviceShuffleIO(ex1)
+    rng = np.random.default_rng(21)
+    released = []
+    timers = []
+
+    class FakeDelivery:
+        def __init__(self, payload):
+            self.views = [memoryview(payload)]
+            self.mapped = True
+
+        def release(self):
+            released.append(True)
+
+    try:
+        io1.publish_device_blocks(
+            13, {p: rng.integers(0, 256, 4000, np.uint8) for p in range(2)}
+        )
+        calls = {"n": 0}
+
+        def fake_mapped(listener, blocks):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # delivery arrives late, after the fetch has failed
+                t = threading.Timer(
+                    0.5,
+                    lambda: listener.on_success(FakeDelivery(b"z" * blocks[0][2])),
+                )
+                t.daemon = True
+                timers.append(t)
+                t.start()
+            else:
+                listener.on_failure(ChannelError("injected mapped fault"))
+
+        # force the mapped path regardless of transport flavor by
+        # presenting a channel-like object with read_mapped_in_queue
+        real_get = ex0.get_channel_to
+
+        class MappedOnly:
+            def __init__(self, ch):
+                self._ch = ch
+
+            def read_mapped_in_queue(self, listener, blocks):
+                fake_mapped(listener, blocks)
+
+        monkeypatch.setattr(
+            ex0, "get_channel_to",
+            lambda mid, purpose="rpc": MappedOnly(real_get(mid, purpose)),
+        )
+        with pytest.raises(FetchFailedError):
+            io0.fetch_device_blocks(13, 0, 2, timeout_s=10)
+        # the late delivery must have been released by the listener side
+        deadline = _time.time() + 5
+        while not released and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert released, "late mapped delivery leaked (release never called)"
+        assert io0.device_buffers.in_use_bytes == 0
+    finally:
+        for t in timers:
+            t.cancel()
+        io0.stop()
+        io1.stop()
+
+
 def test_unpublish_releases_registered_buffers(cluster):
     conf, driver, ex0, ex1 = cluster
     handle = BaseShuffleHandle(shuffle_id=2, num_maps=1, partitioner=HashPartitioner(1))

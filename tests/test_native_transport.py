@@ -604,3 +604,52 @@ def test_device_fetch_uses_mapped_delivery_cross_process():
         ex0.stop()
         ex1.stop()
         driver.stop()
+
+
+def test_mapped_fetch_under_hbm_pressure_spills_and_survives():
+    """Mapped delivery + tight HBM budget: staged slabs spill to the
+    host tier DURING a mapped fetch; bytes stay exact from any tier
+    and the budget never exceeds the cap (the tiered-store guarantees
+    must hold regardless of delivery mechanism)."""
+    import numpy as np
+
+    from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
+
+    conf = _native_conf({"tpu.shuffle.hbm.maxBytes": str(64 * 1024)})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="mp-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="mp-1")
+    parts = 6
+    driver.register_shuffle(
+        BaseShuffleHandle(
+            shuffle_id=71, num_maps=1, partitioner=HashPartitioner(parts)
+        )
+    )
+    io0, io1 = DeviceShuffleIO(ex0), DeviceShuffleIO(ex1)
+    rng = np.random.default_rng(7)
+    data = {
+        p: rng.integers(0, 256, 16 * 1024 - 64, np.uint8) for p in range(parts)
+    }
+    try:
+        io1.publish_device_blocks(71, data)
+        held = io0.fetch_device_blocks(71, 0, parts, timeout_s=60)
+        pool = io0.device_buffers
+        assert pool.spill_count > 0, "tight cap never spilled"
+        assert pool.in_use_bytes <= 64 * 1024
+        # every mapped-fetched block byte-exact, whichever tier holds it
+        for p in range(parts):
+            got = held[p][0].read(0, len(data[p]))
+            assert got == data[p].tobytes(), f"partition {p} differs"
+        # and the reads took the mapped fast path
+        f, s = ex0.node.read_path_stats()
+        assert f == parts and s == 0
+        for bufs in held.values():
+            for b in bufs:
+                b.free()
+        assert pool.in_use_bytes == 0
+    finally:
+        io0.stop()
+        io1.stop()
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
