@@ -302,7 +302,6 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         # fall back to the dispatch-INCLUSIVE per-step time (an
         # over-estimate of compute, hence conservative for the
         # ex-tunnel claim) rather than silently imputing zero compute
-        per_merge_on_chip = 0.0
         for _ in range(2):
             t_hi = _timed_chain(9)
             delta = t_hi - _timed_chain(1)

@@ -206,4 +206,13 @@ class ShuffledRDD(RDD):
         assert self.handle is not None, "shuffle not materialized"
         executor = self.ctx.executor_for_partition(partition)
         reader = executor.get_reader(self.handle, partition, partition + 1)
-        return reader.read()
+
+        def closing():
+            # reader.close() on exit (success OR mid-iteration abandon):
+            # unconsumed fetched streams release deterministically
+            try:
+                yield from reader.read()
+            finally:
+                reader.close()
+
+        return closing()

@@ -80,9 +80,14 @@ class Worker:
         if kind == "reduce":
             handle = req["handle"]
             reader = self.manager.get_reader(handle, req["start"], req["end"])
-            it = reader.read()
-            fn = req.get("reduce_fn")
-            result = fn(it) if fn is not None else list(it)
+            try:
+                it = reader.read()
+                fn = req.get("reduce_fn")
+                result = fn(it) if fn is not None else list(it)
+            finally:
+                # task-completion sweep: a reduce_fn that bails without
+                # consuming must not strand fetched streams until GC
+                reader.close()
             return {"ok": True, "result": result}
         if kind == "stop":
             self._stop.set()

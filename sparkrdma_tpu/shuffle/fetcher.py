@@ -107,6 +107,7 @@ class TpuShuffleFetcherIterator:
         self._bytes_in_flight = 0
         self._pending: List[_PendingFetch] = []
         self._buffered: List[Tuple[int, BinaryIO]] = []
+        self._closed = False
 
         self._start()
 
@@ -214,6 +215,12 @@ class TpuShuffleFetcherIterator:
             # in-flight group never head-of-line blocks a location fetch
             # on the rpc channel (RdmaChannel.java:110-154)
             channel = self._manager.get_channel_to(mid, purpose="data")
+            use_mapped = self._manager.conf.mapped_fetch and hasattr(
+                channel, "read_mapped_in_queue"
+            )
+            if use_mapped:
+                self._fetch_blocks_mapped(fetch, channel, t0)
+                return
             reg = RegisteredBuffer(self._manager.buffer_manager, group.total_length)
             # each slice holds one refcount; buffer returns to the pool
             # when the last stream closes (:399-429)
@@ -235,7 +242,7 @@ class TpuShuffleFetcherIterator:
                 )
             self.metrics.remote_blocks += len(streams)
             self.metrics.remote_bytes += group.total_length
-            self._results.put(_Success(streams, in_flight=group.total_length))
+            self._put_success(streams, group.total_length)
 
         failed_once = threading.Event()
 
@@ -260,7 +267,100 @@ class TpuShuffleFetcherIterator:
             [(block.mkey, block.address, block.length) for _, block in group.blocks],
         )
 
+    def _fetch_blocks_mapped(self, fetch: _PendingFetch, channel, t0) -> None:
+        """Mapped-delivery flavor of the group READ (native transport):
+        no pooled destination buffer — same-host blocks stream straight
+        from page-cache mappings, remote ones from one malloc'd blob.
+        The delivery releases when the LAST of its block streams
+        closes, exactly like the registered buffer's refcounted
+        slices (:399-429)."""
+        mid, group = fetch.manager_id, fetch.group
+
+        def on_success(delivery) -> None:
+            stats = self._manager.reader_stats
+            if stats is not None:
+                stats.update_remote_fetch_histogram(
+                    mid, (time.monotonic() - t0) * 1e3
+                )
+            remaining = [len(delivery.views)]
+            lock = threading.Lock()
+
+            def release_one() -> None:
+                with lock:
+                    remaining[0] -= 1
+                    last = remaining[0] == 0
+                if last:
+                    delivery.release()
+
+            streams: List[Tuple[int, BinaryIO]] = [
+                (pid, MemoryviewInputStream(view, on_close=release_one))
+                for (pid, _block), view in zip(group.blocks, delivery.views)
+            ]
+            self.metrics.remote_blocks += len(streams)
+            self.metrics.remote_bytes += group.total_length
+            self._put_success(streams, group.total_length)
+
+        failed_once = threading.Event()
+
+        def on_failure(e: Exception) -> None:
+            if failed_once.is_set():
+                return  # on_failure may legally fire more than once
+            failed_once.set()
+            self._results.put(
+                _Failure(mid, group.blocks[0][0], e, in_flight=group.total_length)
+            )
+
+        channel.read_mapped_in_queue(
+            FnListener(on_success, on_failure),
+            [(block.mkey, block.address, block.length)
+             for _, block in group.blocks],
+        )
+
     # ------------------------------------------------------------------
+    def _put_success(self, streams, in_flight: int) -> None:
+        """Enqueue delivered streams — unless the iterator has been
+        closed, in which case the delivery's resources (registered
+        slices or mapped page-cache windows) are released RIGHT HERE:
+        a late arrival must never wait for the garbage collector."""
+        with self._lock:
+            # the put happens INSIDE the closed-flag lock: a put racing
+            # close() must either land before the drain (swept there)
+            # or observe _closed and release here — never fall between
+            if not self._closed:
+                self._results.put(_Success(streams, in_flight=in_flight))
+                return
+        for _pid, stream in streams:
+            try:
+                stream.close()
+            except Exception:
+                logger.exception("closing late-delivered stream failed")
+
+    def close(self) -> None:
+        """Release every delivered-but-unconsumed stream: buffered ones
+        and results still queued; in-flight deliveries release on
+        arrival via `_put_success`. The reference runs the same sweep
+        as a task-completion callback
+        (RdmaShuffleFetcherIterator.scala:90-106). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._pending.clear()  # never launch new READs for a dead task
+        leftovers = list(self._buffered)
+        self._buffered.clear()
+        while True:
+            try:
+                r = self._results.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(r, _Success):
+                leftovers.extend(r.streams)
+        for _pid, stream in leftovers:
+            try:
+                stream.close()
+            except Exception:
+                logger.exception("closing unconsumed stream failed")
+
     def _drain_pending(self) -> None:
         """Start queued fetches now under the in-flight cap (:369-379)."""
         max_in_flight = self._manager.conf.max_bytes_in_flight
@@ -289,8 +389,13 @@ class TpuShuffleFetcherIterator:
             with self._lock:
                 self._processed_results += 1
                 self._bytes_in_flight -= result.in_flight
-            self._drain_pending()
             if isinstance(result, _Failure):
+                # the task will abandon this iterator: sweep every
+                # already-delivered stream (and drop queued pending
+                # fetches — launching fresh READs for a dead task,
+                # which the pre-close drain did, is pure waste) before
+                # surfacing the error
+                self.close()
                 err = result.error
                 if isinstance(err, (FetchFailedError, MetadataFetchFailedError)):
                     raise err
@@ -301,6 +406,8 @@ class TpuShuffleFetcherIterator:
                     result.partition_id,
                     str(err),
                 )
+            # only successful progress starts the next queued fetches
+            self._drain_pending()
             if isinstance(result, _Success):
                 self._buffered.extend(result.streams)
         return self._buffered.pop(0)

@@ -40,14 +40,29 @@ class TpuShuffleReader:
     def _record_iter(self) -> Iterator[Tuple]:
         codec = self._manager.resolver.codec
         metrics = self._fetcher.metrics
-        for _pid, stream in self._fetcher:
-            try:
-                for block in iter_compressed_blocks(stream, codec):
-                    for rec in self._serializer.load_stream(BytesIO(block)):
-                        metrics.records_read += 1
-                        yield rec
-            finally:
-                stream.close()
+        try:
+            for _pid, stream in self._fetcher:
+                try:
+                    for block in iter_compressed_blocks(stream, codec):
+                        for rec in self._serializer.load_stream(BytesIO(block)):
+                            metrics.records_read += 1
+                            yield rec
+                finally:
+                    stream.close()
+        finally:
+            # completion OR abandonment (generator finalization): sweep
+            # unconsumed streams so registered slices / mapped windows
+            # release deterministically (the reference's task-completion
+            # cleanup, RdmaShuffleFetcherIterator.scala:90-106)
+            self._fetcher.close()
+
+    def close(self) -> None:
+        """Release unconsumed fetched streams NOW (the reference's
+        task-completion cleanup, RdmaShuffleFetcherIterator.scala:
+        90-106). Generator finalization alone cannot cover a consumer
+        that abandons `read()` without ever starting iteration — task
+        runners call this from a finally. Idempotent."""
+        self._fetcher.close()
 
     def read(self) -> Iterator[Tuple]:
         """Iterator of (key, value) with aggregation/ordering applied."""

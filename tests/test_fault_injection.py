@@ -104,3 +104,108 @@ def test_send_fault_fails_location_fetch(monkeypatch):
     finally:
         ex0.stop()
         driver.stop()
+
+
+def test_failed_fetch_sweeps_unconsumed_streams(monkeypatch):
+    """When one group fails, the iterator's failure path must CLOSE the
+    already-delivered (but unconsumed) streams of other groups — and a
+    group completing AFTER the failure is released on arrival.
+    Registered slices / mapped windows never wait for the GC."""
+    import time as _time
+
+    import numpy as np
+
+    import sparkrdma_tpu.shuffle.fetcher as fetcher_mod
+    from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
+    from sparkrdma_tpu.memory.streams import MemoryviewInputStream
+    from sparkrdma_tpu.shuffle.errors import FetchFailedError
+    from sparkrdma_tpu.shuffle.fetcher import TpuShuffleFetcherIterator
+    from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    created = []
+
+    class RecordingStream(MemoryviewInputStream):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            created.append(self)
+
+    monkeypatch.setattr(fetcher_mod, "MemoryviewInputStream", RecordingStream)
+
+    # read-block cap of one block: each 48KB block is its own group
+    # (the conf clamps below 64 KiB)
+    conf = TpuShuffleConf({"tpu.shuffle.shuffleReadBlockSize": "65536"})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="sweep-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="sweep-1")
+    ex0.start_node_if_missing()
+    ex1.start_node_if_missing()
+    regs = []
+    timers = []
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=41, num_maps=1, partitioner=HashPartitioner(3)
+        )
+        driver.register_shuffle(handle)
+        rng = np.random.default_rng(11)
+        locs = []
+        for p in range(3):
+            payload = rng.integers(0, 256, 48_000, np.uint8)
+            reg = ex1.buffer_manager.get(payload.nbytes)
+            regs.append(reg)
+            np.frombuffer(reg.view, np.uint8, payload.nbytes)[:] = payload
+            locs.append(
+                PartitionLocation(
+                    ex1.local_manager_id, p,
+                    BlockLocation(0, payload.nbytes, reg.mkey),
+                )
+            )
+        ex1.publish_partition_locations(41, -1, locs, num_map_outputs=1)
+
+        state = {"n": 0}
+        lock = threading.Lock()
+        original = TpuChannel.read_in_queue
+
+        def scripted(self, listener, dst_views, blocks):
+            with lock:
+                state["n"] += 1
+                k = state["n"]
+            if k == 1:
+                return original(self, listener, dst_views, blocks)  # delivers
+            if k == 2:
+                listener.on_failure(ChannelError("injected sweep fault"))
+                return
+            # third group: completes AFTER the failure surfaced
+            t = threading.Timer(
+                0.6, lambda: original(self, listener, dst_views, blocks)
+            )
+            t.daemon = True
+            timers.append(t)
+            t.start()
+
+        monkeypatch.setattr(TpuChannel, "read_in_queue", scripted)
+        it = TpuShuffleFetcherIterator(ex0, handle, 0, 3)
+        with pytest.raises(FetchFailedError):
+            while True:
+                it.next()
+        assert state["n"] == 3, "expected three distinct fetch groups"
+        # group 1 delivered before the failure; group 3 delivers late —
+        # BOTH must end up closed without anyone consuming them
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            if len(created) >= 2 and all(s.closed for s in created):
+                break
+            _time.sleep(0.05)
+        assert created, "no streams were ever delivered"
+        assert all(s.closed for s in created), (
+            f"{sum(not s.closed for s in created)} unconsumed stream(s) "
+            "left open after the failure sweep"
+        )
+    finally:
+        for t in timers:
+            t.cancel()
+        for reg in regs:
+            ex1.buffer_manager.put(reg)
+        ex0.stop()
+        ex1.stop()
+        driver.stop()

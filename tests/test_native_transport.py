@@ -108,6 +108,10 @@ def test_shuffle_e2e_over_native_transport():
         assert set(got) == set(expected)
         for k in expected:
             assert sorted(got[k]) == sorted(expected[k])
+        # the record plane's remote reads rode MAPPED delivery off the
+        # publishers' mmap-registered sort files (zero-copy page cache)
+        f0, s0 = ex0.node.read_path_stats()
+        assert f0 > 0 and s0 == 0, (f0, s0)
     finally:
         ex0.stop()
         ex1.stop()
