@@ -331,6 +331,14 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         reducer_io = ios[0]
         t_wall0 = time.perf_counter()
         pool = ThreadPoolExecutor(1, thread_name_prefix="e2e-fetch")
+        # Verification scalars stay ON DEVICE until every merge is done,
+        # then come back in ONE batched readback. Measured on this rig
+        # (DESIGN.md §13): reading back ANY output of a large program
+        # flips the axon runtime into a mode where the NEXT host->HBM
+        # transfer stalls 13-25 s — interleaved per-reducer readbacks
+        # were 7x-ing the whole fetch/stage plane (150-200 s of stalls
+        # at 1 GiB). Deferring the readbacks pays that cost once.
+        packed_rows = []
         try:
             fut = pool.submit(fetch_one, 0)
             for r in range(reducers):
@@ -355,20 +363,8 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
                         [b.length // 4 for b in bufs], jnp.int32
                     )
                     merged, packed = merge(arrs, counts)
-                # ONE readback: [count, sum, xor, sorted]
-                t, csum, cxor, ok = (int(x) for x in np.asarray(packed))
-                if t != exp_cnt[r]:
-                    raise SystemExit(
-                        f"E2E FAILED: reducer {r} count {t} != {exp_cnt[r]}"
-                    )
-                if csum != int(exp_sum[r]) or cxor != int(exp_xor[r]):
-                    raise SystemExit(
-                        f"E2E FAILED: reducer {r} checksum mismatch"
-                    )
-                if not ok:
-                    raise SystemExit(
-                        f"E2E FAILED: reducer {r} output not sorted"
-                    )
+                packed_rows.append(packed)  # tiny, stays on device
+                jax.block_until_ready(merged)
                 for b in bufs:
                     b.free()
                 del merged
@@ -378,6 +374,20 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             # executors underneath the in-flight prefetch, nor hang
             # interpreter exit joining a 120 s fetch
             pool.shutdown(wait=False, cancel_futures=True)
+        # ONE readback for all reducers: [count, sum, xor, sorted] rows
+        t0 = time.perf_counter()
+        stats = np.asarray(jax.device_get(jnp.stack(packed_rows)))
+        t_readback = time.perf_counter() - t0
+        for r in range(reducers):
+            t, csum, cxor, ok = (int(x) for x in stats[r])
+            if t != exp_cnt[r]:
+                raise SystemExit(
+                    f"E2E FAILED: reducer {r} count {t} != {exp_cnt[r]}"
+                )
+            if csum != int(exp_sum[r]) or cxor != int(exp_xor[r]):
+                raise SystemExit(f"E2E FAILED: reducer {r} checksum mismatch")
+            if not ok:
+                raise SystemExit(f"E2E FAILED: reducer {r} output not sorted")
         reduce_wall = time.perf_counter() - t_wall0
         # only wall time counts toward the total; per-plane busy times
         # are informational (they overlap)
@@ -385,6 +395,7 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         extra_busy = {
             "fetch_stage_busy_s": round(t_fetch, 3),
             "device_merge_busy_s": round(t_merge, 3),
+            "verify_readback_s": round(t_readback, 3),
             "overlap_saved_s": round(
                 max(0.0, t_fetch + t_merge - reduce_wall), 3
             ),
@@ -417,11 +428,13 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
     # in the table, labeled contended, for transparency.
     publish_uncontended = publish_solo * executors
     # reduce-side residual: wall not accounted to either plane's busy
-    # clock (scheduling gaps, Python orchestration)
+    # clock or the batched verify readback (scheduling gaps, Python
+    # orchestration)
     reduce_residual = max(
         phases["reduce_wall_s"]
         - extra_busy["fetch_stage_busy_s"]
-        - t_merge_final,
+        - t_merge_final
+        - extra_busy["verify_readback_s"],
         0.0,
     )
     attribution = {
@@ -433,6 +446,7 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         "framework_reduce_residual_s": round(reduce_residual, 3),
         "tunnel_fetch_stage_s": round(fs, 3),
         "tunnel_merge_dispatch_readback_s": round(tunnel_merge, 3),
+        "tunnel_verify_readback_s": extra_busy["verify_readback_s"],
     }
     # the framework's OWN code (registration+publish+location RPC+READ
     # transport+orchestration residual): what the reference's plugin

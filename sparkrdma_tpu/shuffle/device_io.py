@@ -30,7 +30,7 @@ from sparkrdma_tpu.ops.hbm_arena import (
     _size_class,
 )
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
-from sparkrdma_tpu.transport import FnListener
+from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
 
 logger = logging.getLogger(__name__)
 
@@ -286,7 +286,7 @@ class DeviceShuffleIO:
                     out.setdefault(loc.partition_id, []).append(dev)
                     continue
                 ch = mgr.get_channel_to(loc.manager_id, purpose="data")
-                if conf.mapped_fetch and hasattr(ch, "read_mapped_in_queue"):
+                if mapped_delivery_enabled(conf, ch):
                     pending.append(start_read_mapped(len(pending), loc, ch))
                 else:
                     reg = mgr.buffer_manager.get(loc.block.length)

@@ -39,7 +39,7 @@ from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleMan
 from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
-from sparkrdma_tpu.transport import FnListener
+from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
 
 logger = logging.getLogger(__name__)
 
@@ -169,7 +169,9 @@ class TpuShuffleFetcherIterator:
         if local_streams:
             with self._lock:
                 self._total_results += 1
-            self._results.put(_Success(local_streams))
+            # via _put_success: a close() racing this thread must sweep
+            # (or be handed) these streams, never strand them
+            self._put_success(local_streams, 0)
 
         by_manager: Dict[ShuffleManagerId, List[Tuple[int, BlockLocation]]] = {}
         for loc in locations:
@@ -195,6 +197,10 @@ class TpuShuffleFetcherIterator:
         start_now: List[_PendingFetch] = []
         with self._lock:
             self._total_results += len(fetches)
+            if self._closed:
+                # closed while resolving: never launch READs for a
+                # dead task (accounting is moot — has_next is False)
+                fetches = []
             for fetch in fetches:
                 if self._bytes_in_flight < max_in_flight:
                     self._bytes_in_flight += fetch.group.total_length
@@ -206,6 +212,34 @@ class TpuShuffleFetcherIterator:
         for fetch in start_now:
             self._fetch_blocks(fetch)
 
+    def _group_failure(self, mid, group, cleanup=None):
+        """Once-only failure handler for one group READ (on_failure may
+        legally fire more than once; ``cleanup`` releases the group's
+        destination resources, if any, before the error is queued)."""
+        failed_once = threading.Event()
+
+        def on_failure(e: Exception) -> None:
+            if failed_once.is_set():
+                return
+            failed_once.set()
+            if cleanup is not None:
+                cleanup()
+            self._results.put(
+                _Failure(mid, group.blocks[0][0], e, in_flight=group.total_length)
+            )
+
+        return on_failure
+
+    def _deliver_group(self, mid, group, streams, t0) -> None:
+        """Shared success epilogue: histogram, metrics, closed-aware
+        enqueue — ONE definition for both delivery flavors."""
+        stats = self._manager.reader_stats
+        if stats is not None:
+            stats.update_remote_fetch_histogram(mid, (time.monotonic() - t0) * 1e3)
+        self.metrics.remote_blocks += len(streams)
+        self.metrics.remote_bytes += group.total_length
+        self._put_success(streams, group.total_length)
+
     def _fetch_blocks(self, fetch: _PendingFetch) -> None:
         """Issue one one-sided READ for a whole group (:132-218)."""
         mid, group = fetch.manager_id, fetch.group
@@ -215,10 +249,7 @@ class TpuShuffleFetcherIterator:
             # in-flight group never head-of-line blocks a location fetch
             # on the rpc channel (RdmaChannel.java:110-154)
             channel = self._manager.get_channel_to(mid, purpose="data")
-            use_mapped = self._manager.conf.mapped_fetch and hasattr(
-                channel, "read_mapped_in_queue"
-            )
-            if use_mapped:
+            if mapped_delivery_enabled(self._manager.conf, channel):
                 self._fetch_blocks_mapped(fetch, channel, t0)
                 return
             reg = RegisteredBuffer(self._manager.buffer_manager, group.total_length)
@@ -232,37 +263,20 @@ class TpuShuffleFetcherIterator:
             return
 
         def on_success(_) -> None:
-            stats = self._manager.reader_stats
-            if stats is not None:
-                stats.update_remote_fetch_histogram(mid, (time.monotonic() - t0) * 1e3)
-            streams: List[Tuple[int, BinaryIO]] = []
-            for (pid, _block), sl in zip(group.blocks, slices):
-                streams.append(
-                    (pid, MemoryviewInputStream(sl.view, on_close=sl.release))
-                )
-            self.metrics.remote_blocks += len(streams)
-            self.metrics.remote_bytes += group.total_length
-            self._put_success(streams, group.total_length)
-
-        failed_once = threading.Event()
-
-        def on_failure(e: Exception) -> None:
-            if failed_once.is_set():
-                return  # on_failure may legally fire more than once
-            failed_once.set()
-            for sl in slices:
-                sl.release()
-            self._results.put(
-                _Failure(
-                    mid,
-                    group.blocks[0][0],
-                    e,
-                    in_flight=group.total_length,
-                )
-            )
+            streams: List[Tuple[int, BinaryIO]] = [
+                (pid, MemoryviewInputStream(sl.view, on_close=sl.release))
+                for (pid, _block), sl in zip(group.blocks, slices)
+            ]
+            self._deliver_group(mid, group, streams, t0)
 
         channel.read_in_queue(
-            FnListener(on_success, on_failure),
+            FnListener(
+                on_success,
+                self._group_failure(
+                    mid, group,
+                    cleanup=lambda: [sl.release() for sl in slices],
+                ),
+            ),
             [sl.view for sl in slices],
             [(block.mkey, block.address, block.length) for _, block in group.blocks],
         )
@@ -277,11 +291,6 @@ class TpuShuffleFetcherIterator:
         mid, group = fetch.manager_id, fetch.group
 
         def on_success(delivery) -> None:
-            stats = self._manager.reader_stats
-            if stats is not None:
-                stats.update_remote_fetch_histogram(
-                    mid, (time.monotonic() - t0) * 1e3
-                )
             remaining = [len(delivery.views)]
             lock = threading.Lock()
 
@@ -296,22 +305,10 @@ class TpuShuffleFetcherIterator:
                 (pid, MemoryviewInputStream(view, on_close=release_one))
                 for (pid, _block), view in zip(group.blocks, delivery.views)
             ]
-            self.metrics.remote_blocks += len(streams)
-            self.metrics.remote_bytes += group.total_length
-            self._put_success(streams, group.total_length)
-
-        failed_once = threading.Event()
-
-        def on_failure(e: Exception) -> None:
-            if failed_once.is_set():
-                return  # on_failure may legally fire more than once
-            failed_once.set()
-            self._results.put(
-                _Failure(mid, group.blocks[0][0], e, in_flight=group.total_length)
-            )
+            self._deliver_group(mid, group, streams, t0)
 
         channel.read_mapped_in_queue(
-            FnListener(on_success, on_failure),
+            FnListener(on_success, self._group_failure(mid, group)),
             [(block.mkey, block.address, block.length)
              for _, block in group.blocks],
         )
@@ -377,6 +374,11 @@ class TpuShuffleFetcherIterator:
         if self._buffered:
             return True
         with self._lock:
+            # a closed iterator is exhausted: pending fetches were
+            # dropped and late deliveries release without enqueueing,
+            # so waiting on the result count would hang forever
+            if self._closed:
+                return False
             return self._processed_results < self._total_results
 
     def next(self) -> Tuple[int, BinaryIO]:

@@ -27,6 +27,14 @@ def create_node(conf, host, is_executor, executor_id, recv_listener=None,
     )
 
 
+def mapped_delivery_enabled(conf, channel) -> bool:
+    """True when a fetch should use mapped (zero-copy) delivery: the
+    conf allows it and the channel's plane implements it (native
+    transport only). Single definition so the record-plane fetcher and
+    the device-block fetcher cannot drift."""
+    return conf.mapped_fetch and hasattr(channel, "read_mapped_in_queue")
+
+
 __all__ = [
     "CompletionListener",
     "FnListener",
@@ -34,4 +42,5 @@ __all__ = [
     "ChannelError",
     "TpuNode",
     "create_node",
+    "mapped_delivery_enabled",
 ]

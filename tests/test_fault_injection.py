@@ -185,9 +185,20 @@ def test_failed_fetch_sweeps_unconsumed_streams(monkeypatch):
 
         monkeypatch.setattr(TpuChannel, "read_in_queue", scripted)
         it = TpuShuffleFetcherIterator(ex0, handle, 0, 3)
+        # streams RETURNED by next() are the caller's to close (the
+        # reader's per-stream finally); the sweep owns only unreturned
+        # ones — mirror that contract here
+        returned = []
         with pytest.raises(FetchFailedError):
             while True:
-                it.next()
+                returned.append(it.next())
+        for _pid, s in returned:
+            s.close()
+        # the resolver thread issues the groups concurrently with the
+        # failing next(): wait for all three to have been posted
+        deadline = _time.time() + 5
+        while _time.time() < deadline and state["n"] < 3:
+            _time.sleep(0.05)
         assert state["n"] == 3, "expected three distinct fetch groups"
         # group 1 delivered before the failure; group 3 delivers late —
         # BOTH must end up closed without anyone consuming them
