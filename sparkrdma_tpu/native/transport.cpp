@@ -51,6 +51,7 @@
 #include <algorithm>
 #include <array>
 #include <atomic>
+#include <memory>
 #include <chrono>
 #include <condition_variable>
 #include <deque>
@@ -265,6 +266,15 @@ inline bool stat_matches(const struct stat& st, uint64_t dev, uint64_t ino,
 
 // one same-host pread job, executed on the file worker thread so a
 // cold-cache disk read can never head-of-line block the epoll loop
+// shared completion state for a SPLIT file task: a multi-block pread
+// task fans out over the worker pool (the WR-list-striping analogue);
+// the LAST part to finish posts the single FILE_DONE / FILE_FALLBACK,
+// so no part can still be writing into dst when a fallback re-streams
+struct TaskGroup {
+  std::atomic<int> remaining{0};
+  std::atomic<bool> failed{false};
+};
+
 struct FileTask {
   uint64_t channel = 0;
   uint64_t req_id = 0;
@@ -273,6 +283,7 @@ struct FileTask {
   std::vector<FileRef> files;
   bool mapped = false;           // mmap instead of pread
   std::vector<uint8_t> records;  // mapped result: n x 32B (ptr,len,base,maplen)
+  std::shared_ptr<TaskGroup> group;  // non-null: one part of a split task
 };
 
 struct Node {
@@ -811,6 +822,13 @@ void file_worker_main(Node* n) {
       n->ftq.pop_front();
     }
     bool ok = do_file_task(t, fd_cache);
+    if (t.group) {
+      // one part of a split task: only the LAST finisher completes
+      // the request (success only if every part succeeded)
+      if (!ok) t.group->failed.store(true);
+      if (t.group->remaining.fetch_sub(1) != 1) continue;
+      ok = !t.group->failed.load();
+    }
     Command cmd;
     cmd.kind = ok ? Command::FILE_DONE : Command::FILE_FALLBACK;
     cmd.channel = t.channel;
@@ -1121,11 +1139,62 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         n->file_pending.emplace(std::make_pair(c->id, c->cur_req),
                                 std::move(it->second));
         c->reads.erase(it);
-        {
-          std::lock_guard<std::mutex> g(n->ft_mu);
-          n->ftq.push_back(std::move(t));
+        // multi-block pread tasks fan out over the worker pool (the
+        // WR-list striping analogue): contiguous block ranges, each
+        // part's dst pre-offset, one shared completion. Mapped tasks
+        // stay whole (their records must keep request order). The
+        // worker vector is append-only and fully built before any
+        // channel exists, so reading its size here is safe.
+        size_t nworkers = n->file_workers.size();
+        uint64_t total_bytes = 0;
+        for (uint64_t L : t.lens) total_bytes += L;
+        // split only when the work amortizes the dispatch (a few MB
+        // floor) and balance parts by BYTES, not block count — one fat
+        // block among small ones must not leave a part doing all the
+        // copying while the others pay pure thread overhead
+        if (!t.mapped && nworkers > 1 && t.files.size() > 1 &&
+            total_bytes >= (4ull << 20)) {
+          size_t parts = std::min(nworkers, t.files.size());
+          uint64_t target = total_bytes / parts + 1;
+          auto grp = std::make_shared<TaskGroup>();
+          std::vector<FileTask> subs;
+          uint64_t off = 0, acc = 0;
+          FileTask s;
+          s.channel = t.channel;
+          s.req_id = t.req_id;
+          s.group = grp;
+          s.dst = t.dst;
+          for (size_t i = 0; i < t.files.size(); i++) {
+            if (!s.files.empty() && acc >= target &&
+                subs.size() + 1 < parts) {
+              subs.push_back(std::move(s));
+              s = FileTask();
+              s.channel = t.channel;
+              s.req_id = t.req_id;
+              s.group = grp;
+              s.dst = t.dst + off;
+              acc = 0;
+            }
+            s.files.push_back(std::move(t.files[i]));
+            s.lens.push_back(t.lens[i]);
+            acc += t.lens[i];
+            off += t.lens[i];
+          }
+          subs.push_back(std::move(s));
+          // set the count BEFORE any part is enqueued
+          grp->remaining.store((int)subs.size());
+          {
+            std::lock_guard<std::mutex> g(n->ft_mu);
+            for (auto& s : subs) n->ftq.push_back(std::move(s));
+          }
+          n->ft_cv.notify_all();
+        } else {
+          {
+            std::lock_guard<std::mutex> g(n->ft_mu);
+            n->ftq.push_back(std::move(t));
+          }
+          n->ft_cv.notify_one();
         }
-        n->ft_cv.notify_one();
       } else {
         // different host (proof unreachable): latch the fast path off
         // for this conn. A malformed frame just streams this one read.

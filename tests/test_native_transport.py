@@ -657,3 +657,47 @@ def test_mapped_fetch_under_hbm_pressure_spills_and_survives():
         ex0.stop()
         ex1.stop()
         driver.stop()
+
+
+def test_multiblock_file_read_splits_across_workers():
+    """A single READ naming several file-backed blocks fans its preads
+    over the worker pool (the WR-list striping analogue): one combined
+    destination, one completion, bytes exact, counted as ONE fast-path
+    read."""
+    import numpy as np
+
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    srv = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", False, "split-srv")
+    cli = NativeTpuNode(
+        TpuShuffleConf({"tpu.shuffle.fileWorkers": "4"}),
+        "127.0.0.1", True, "split-cli",
+    )
+    try:
+        rng = np.random.default_rng(23)
+        buf = TpuBuffer(srv.pd, 16 << 20, register=True)
+        src = rng.integers(0, 256, 16 << 20, np.uint8)
+        np.frombuffer(buf.view, np.uint8)[:] = src
+        ch = cli.get_channel("127.0.0.1", srv.port, "data")
+        # one dst covering three discontiguous blocks totalling > 4 MiB
+        # (the split floor) -> the scatter path posts ONE multi-block
+        # read -> one byte-balanced split file task
+        blocks = [(buf.mkey, 0, 3 << 20), (buf.mkey, 4 << 20, 5 << 20),
+                  (buf.mkey, 10 << 20, 2 << 20)]
+        total = sum(b[2] for b in blocks)
+        dst = memoryview(bytearray(total))
+        done, errs = threading.Event(), []
+        ch.read_in_queue(
+            FnListener(lambda _: done.set(), lambda e: (errs.append(e), done.set())),
+            [dst],
+            blocks,
+        )
+        assert done.wait(10) and not errs, errs
+        want = b"".join(src[a:a+l].tobytes() for _mk, a, l in blocks)
+        assert bytes(dst) == want, "split multi-block read bytes differ"
+        f, s = cli.read_path_stats()
+        assert f == 1 and s == 0, (f, s)
+    finally:
+        cli.stop()
+        srv.stop()
