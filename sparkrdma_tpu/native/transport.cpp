@@ -333,6 +333,9 @@ struct Node {
   // from Python for tests and the bench harness)
   std::atomic<uint64_t> stat_file_reads{0};
   std::atomic<uint64_t> stat_streamed_reads{0};
+  // parts created by splitting multi-block pread tasks (observable so
+  // tests can assert the split actually engaged)
+  std::atomic<uint64_t> stat_split_parts{0};
   // client knob: 0 forces plain READ_REQ (streamed) even when the peer
   // could answer READ_FILE — used to exercise/bench the remote path on
   // a single host. Mapped reads always probe the file path.
@@ -1155,34 +1158,47 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         if (!t.mapped && nworkers > 1 && t.files.size() > 1 &&
             total_bytes >= (4ull << 20)) {
           size_t parts = std::min(nworkers, t.files.size());
-          uint64_t target = total_bytes / parts + 1;
           auto grp = std::make_shared<TaskGroup>();
           std::vector<FileTask> subs;
-          uint64_t off = 0, acc = 0;
+          uint64_t off = 0, acc = 0, remaining_bytes = total_bytes;
           FileTask s;
           s.channel = t.channel;
           s.req_id = t.req_id;
           s.group = grp;
           s.dst = t.dst;
           for (size_t i = 0; i < t.files.size(); i++) {
-            if (!s.files.empty() && acc >= target &&
-                subs.size() + 1 < parts) {
-              subs.push_back(std::move(s));
-              s = FileTask();
-              s.channel = t.channel;
-              s.req_id = t.req_id;
-              s.group = grp;
-              s.dst = t.dst + off;
-              acc = 0;
-            }
             s.files.push_back(std::move(t.files[i]));
             s.lens.push_back(t.lens[i]);
             acc += t.lens[i];
             off += t.lens[i];
+            remaining_bytes -= t.lens[i];
+            bool more_parts = subs.size() + 1 < parts;
+            bool more_files = i + 1 < t.files.size();
+            if (more_parts && more_files) {
+              // close this part when stopping NOW lands closer to its
+              // fair share (remaining bytes / remaining parts) than
+              // absorbing the next block would — keeps parts byte-
+              // balanced even when one fat block sits among small ones
+              uint64_t share =
+                  (acc + remaining_bytes) / (parts - subs.size());
+              uint64_t next = t.lens[i + 1];
+              uint64_t over = acc + next > share ? acc + next - share : 0;
+              uint64_t under = share > acc ? share - acc : 0;
+              if (acc >= share || over > under) {
+                subs.push_back(std::move(s));
+                s = FileTask();
+                s.channel = t.channel;
+                s.req_id = t.req_id;
+                s.group = grp;
+                s.dst = t.dst + off;
+                acc = 0;
+              }
+            }
           }
           subs.push_back(std::move(s));
           // set the count BEFORE any part is enqueued
           grp->remaining.store((int)subs.size());
+          n->stat_split_parts.fetch_add(subs.size());
           {
             std::lock_guard<std::mutex> g(n->ft_mu);
             for (auto& s : subs) n->ftq.push_back(std::move(s));
@@ -1682,6 +1698,10 @@ uint64_t srt_stat_file_reads(void* np) {
 
 uint64_t srt_stat_streamed_reads(void* np) {
   return ((Node*)np)->stat_streamed_reads.load();
+}
+
+uint64_t srt_stat_split_parts(void* np) {
+  return ((Node*)np)->stat_split_parts.load();
 }
 
 uint64_t srt_region_count(void* np) {

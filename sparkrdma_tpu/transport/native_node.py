@@ -716,6 +716,14 @@ class NativeTpuNode:
             self._lib.srt_stat_streamed_reads(np_handle),
         )
 
+    def split_parts(self) -> int:
+        """Parts created by splitting multi-block pread tasks across
+        the worker pool (0 = the split never engaged)."""
+        np_handle = self._np
+        if not np_handle:
+            return 0
+        return self._lib.srt_stat_split_parts(np_handle)
+
     def _close_channel(self, ch: NativeTpuChannel) -> None:
         ch._dead.set()
         if not self._stopped.is_set():

@@ -495,7 +495,7 @@ def test_mapped_read_zero_copy_and_fallback():
         buf = TpuBuffer(srv.pd, 300_000, register=True)  # shm-backed
         src = rng.integers(0, 256, 300_000, np.uint8)
         np.frombuffer(buf.view, np.uint8)[:] = src
-        ch = cli.get_channel("127.0.0.1", srv.port, "data")
+        ch = cli.get_channel("127.0.0.1", srv.port, purpose="data")
 
         def mapped_read(blocks):
             box, ev = {}, threading.Event()
@@ -557,7 +557,7 @@ def test_streamed_read_of_file_backed_region_uses_sendfile_path():
         buf = TpuBuffer(srv.pd, 1 << 20, register=True)
         src = rng.integers(0, 256, 1 << 20, np.uint8)
         np.frombuffer(buf.view, np.uint8)[:] = src
-        ch = cli.get_channel("127.0.0.1", srv.port, "data")
+        ch = cli.get_channel("127.0.0.1", srv.port, purpose="data")
         dst = memoryview(bytearray(500_000))
         done, errs = threading.Event(), []
         ch.read_in_queue(
@@ -679,7 +679,7 @@ def test_multiblock_file_read_splits_across_workers():
         buf = TpuBuffer(srv.pd, 16 << 20, register=True)
         src = rng.integers(0, 256, 16 << 20, np.uint8)
         np.frombuffer(buf.view, np.uint8)[:] = src
-        ch = cli.get_channel("127.0.0.1", srv.port, "data")
+        ch = cli.get_channel("127.0.0.1", srv.port, purpose="data")
         # one dst covering three discontiguous blocks totalling > 4 MiB
         # (the split floor) -> the scatter path posts ONE multi-block
         # read -> one byte-balanced split file task
@@ -698,6 +698,8 @@ def test_multiblock_file_read_splits_across_workers():
         assert bytes(dst) == want, "split multi-block read bytes differ"
         f, s = cli.read_path_stats()
         assert f == 1 and s == 0, (f, s)
+        # the split actually engaged (not just the whole-task path)
+        assert cli.split_parts() >= 2, cli.split_parts()
     finally:
         cli.stop()
         srv.stop()
