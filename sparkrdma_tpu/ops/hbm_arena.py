@@ -108,6 +108,17 @@ class DeviceBuffer:
         with self._tier_lock:
             if self.array is None:
                 return  # raced: someone else already moved it
+            with self._manager._lock:
+                if self.handle not in self._manager._handles:
+                    # raced a free(): the victim pick happened before
+                    # put() removed this buffer from the handle table,
+                    # and put() then returned it (array intact) to the
+                    # pool stack. Spilling a POOLED slab would release
+                    # its device budget a second time — the only
+                    # negative-budget race the threaded stress ever
+                    # produced. (Pool reuse re-inserts the same handle,
+                    # so a re-gotten buffer spills normally again.)
+                    return
             self._host = np.asarray(self.array)
             self.array.delete()
             self.array = None

@@ -291,3 +291,36 @@ def test_nothing_spillable_raises():
     with pytest.raises(MemoryError):
         mgr.get(1)
     mgr.stop()
+
+
+def test_spill_of_freed_pooled_buffer_is_a_noop():
+    """Race regression (caught by the threaded stress ~1-in-8 runs):
+    _make_room picks its victim from the handle table WITHOUT holding
+    any lock, so the victim can be free()d — and returned, array
+    intact, to the pool stack — before its spill_to_host runs. The
+    spill must then be a no-op: spilling a pooled slab released its
+    device budget a SECOND time (in_use_bytes went negative) and left
+    a tierless zombie in the pool."""
+    from sparkrdma_tpu.ops.hbm_arena import MIN_BLOCK_SIZE, DeviceBufferManager
+
+    mgr = DeviceBufferManager(max_bytes=4 * MIN_BLOCK_SIZE)
+    try:
+        buf = mgr.stage_bytes(b"y" * 100)
+        assert mgr.in_use_bytes == MIN_BLOCK_SIZE
+        buf.free()  # pooled: array kept, budget released, handle removed
+        assert mgr.in_use_bytes == 0
+        # the raced victim pick fires AFTER the free
+        buf.spill_to_host()
+        assert mgr.in_use_bytes == 0, "pooled slab's budget released twice"
+        assert mgr.host_bytes == 0
+        assert buf.array is not None and not buf.spilled, (
+            "pooled slab was demoted to the host tier"
+        )
+        # the pooled slab is still perfectly reusable
+        buf2 = mgr.stage_bytes(b"z" * 200)
+        assert buf2 is buf  # LIFO pool reuse
+        assert bytes(buf2.read(0, 200)) == b"z" * 200
+        buf2.free()
+        assert mgr.in_use_bytes == 0
+    finally:
+        mgr.stop()
