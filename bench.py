@@ -588,12 +588,21 @@ def bench_device(jax) -> dict:
 
 
 def main() -> None:
+    import os
+
+    from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+
     out = {}
     out.update(bench_native_reads())
     import jax
 
     out.update(bench_device(jax))
     value = out["native_read_samehost_gbps"]
+    trace_path = os.environ.get("SRT_TRACE_OUT", "bench_trace.json")
+    try:
+        export_chrome_trace(trace_path)
+    except OSError:
+        trace_path = None
     record = {
         "metric": "shuffle_read_gbps_per_chip",
         "value": value,
@@ -609,6 +618,8 @@ def main() -> None:
             "host<->HBM staging excluded: behind the axon tunnel it "
             "would measure the tunnel, not the framework"
         ),
+        "obs_registry": get_registry().snapshot(),
+        "trace_file": trace_path,
     }
     print(json.dumps(record))
 

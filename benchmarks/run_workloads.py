@@ -770,6 +770,12 @@ if __name__ == "__main__":
         "--out", default=None,
         help="also write every record to this JSON artifact file",
     )
+    ap.add_argument(
+        "--trace-out", default=None,
+        help="export the shuffle span trace (Chrome trace-event JSON, "
+        "Perfetto-loadable) to this path; defaults to <out>.trace.json "
+        "when --out is given",
+    )
     args = ap.parse_args()
     runs = {
         "engine": lambda: bench_engine_terasort(args.scale, args.transport),
@@ -787,6 +793,15 @@ if __name__ == "__main__":
     for name, fn in runs.items():
         if args.only in (None, name):
             fn()
+    from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+
+    trace_out = args.trace_out or (f"{args.out}.trace.json" if args.out else None)
+    if trace_out:
+        trace = export_chrome_trace(trace_out)
+        print(
+            f"wrote {trace_out} ({len(trace['traceEvents'])} trace events)",
+            flush=True,
+        )
     if args.out:
         with open(args.out, "w") as f:
             json.dump(
@@ -796,6 +811,8 @@ if __name__ == "__main__":
                     "transport": args.transport,
                     "e2e_gb": args.e2e_gb,
                     "workloads": RECORDS,
+                    "obs_registry": get_registry().snapshot(),
+                    "trace_file": trace_out,
                 },
                 f, indent=1,
             )

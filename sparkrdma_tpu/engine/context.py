@@ -186,6 +186,29 @@ class TpuContext:
         raise RuntimeError("unreachable")
 
     # ------------------------------------------------------------------
+    def metrics_snapshot(self) -> Dict[str, dict]:
+        """Per-role manager snapshots plus the process-wide registry.
+
+        In this in-process topology every manager shares one registry,
+        so ``registry`` is reported once at the top level (the per-role
+        entries keep their role-filtered view from
+        ``TpuShuffleManager.metrics_snapshot``)."""
+        from sparkrdma_tpu.obs import get_registry
+
+        snap: Dict[str, dict] = {
+            "driver": self.driver.metrics_snapshot(),
+        }
+        for executor in self.executors:
+            snap[executor.executor_id] = executor.metrics_snapshot()
+        snap["registry"] = get_registry().snapshot()
+        return snap
+
+    def export_trace(self, path: str) -> dict:
+        """Write the Chrome-trace JSON for every role's tracer."""
+        from sparkrdma_tpu.obs import export_chrome_trace
+
+        return export_chrome_trace(path)
+
     def stop(self) -> None:
         if self._stopped:
             return

@@ -24,10 +24,18 @@ from typing import Deque, Dict, Optional
 
 from sparkrdma_tpu.memory.buffer import TpuBuffer
 from sparkrdma_tpu.memory.registry import ProtectionDomain
+from sparkrdma_tpu.obs import get_registry
 
 logger = logging.getLogger(__name__)
 
 MIN_BLOCK_SIZE = 16 * 1024
+
+# pool counters are process-global (the pools are per-node but share one
+# allocation discipline); resolved once at import so get()/put() stay hot
+_M_POOL_HITS = get_registry().counter("mempool.hits")
+_M_POOL_MISSES = get_registry().counter("mempool.misses")
+_M_POOL_RETURNS = get_registry().counter("mempool.returns")
+_M_POOL_FREES = get_registry().counter("mempool.frees")
 
 
 def next_power_of_2(n: int) -> int:
@@ -50,8 +58,10 @@ class _AllocatorStack:
     def get(self) -> TpuBuffer:
         with self.lock:
             if self.stack:
+                _M_POOL_HITS.inc()
                 return self.stack.pop()
             self.total_alloc += 1
+        _M_POOL_MISSES.inc()
         return TpuBuffer(self.pd, self.length)
 
     def put(self, buf: TpuBuffer) -> bool:
@@ -116,7 +126,10 @@ class TpuBufferManager:
         with self._lock:
             stack = self._stacks.get(buf.length) if buf.mkey else None
         if stack is None or self._stopped or not stack.put(buf):
+            _M_POOL_FREES.inc()
             buf.free()
+        else:
+            _M_POOL_RETURNS.inc()
 
     def get_unregistered(self, length: int) -> TpuBuffer:
         """Non-pooled, unregistered scratch allocation (chunk staging).

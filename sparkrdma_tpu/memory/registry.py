@@ -18,9 +18,15 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from sparkrdma_tpu.obs import get_registry
+
 
 class RegionError(KeyError):
     """Access through an unknown or out-of-range (mkey, offset, length)."""
+
+
+_M_REGISTRATIONS = get_registry().counter("mempool.registrations")
+_M_DEREGISTRATIONS = get_registry().counter("mempool.deregistrations")
 
 
 class ProtectionDomain:
@@ -62,11 +68,14 @@ class ProtectionDomain:
             mkey = self._next_mkey
             self._next_mkey += 1
             self._regions[mkey] = view
+        _M_REGISTRATIONS.inc()
         return mkey
 
     def deregister(self, mkey: int) -> None:
         with self._lock:
-            self._regions.pop(mkey, None)
+            removed = self._regions.pop(mkey, None)
+        if removed is not None:
+            _M_DEREGISTRATIONS.inc()
 
     def region_length(self, mkey: int) -> int:
         """Total byte length of a registered region (for local

@@ -1,0 +1,43 @@
+"""Unified observability layer: metrics registry + shuffle tracing.
+
+See docs/OBSERVABILITY.md for metric names, label conventions, and the
+Perfetto workflow. ``python -m sparkrdma_tpu.obs`` dumps the registry.
+"""
+
+from sparkrdma_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    metric_key,
+)
+from sparkrdma_tpu.obs.trace import (
+    Span,
+    Tracer,
+    all_tracers,
+    collect_spans,
+    export_chrome_trace,
+    get_tracer,
+    mint_trace_id,
+    now,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+    "all_tracers",
+    "collect_spans",
+    "export_chrome_trace",
+    "get_registry",
+    "get_tracer",
+    "metric_key",
+    "mint_trace_id",
+    "now",
+    "to_chrome_trace",
+]

@@ -1,0 +1,90 @@
+"""``python -m sparkrdma_tpu.obs`` — dump the unified metrics registry.
+
+Without flags this prints the process-wide registry snapshot as JSON
+(empty unless something in this process has run shuffle code first,
+which is why ``--demo`` exists: it drives a small in-process cluster
+shuffle — driver + two executors over real TCP, wrapper writer method
+— so every layer's counters populate). ``--trace-out PATH`` also
+exports the span trace as Chrome trace-event JSON (open in Perfetto or
+chrome://tracing).
+
+The demo is jax-free: it exercises the host shuffle planes (transport,
+rpc, writer, mempool, reader) only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+
+
+def _run_demo() -> None:
+    from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "wrapper",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleReadBlockSize": "65536",
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=2, partitioner=HashPartitioner(2)
+        )
+        driver.register_shuffle(handle)
+        records = [(f"key-{i % 97}", i) for i in range(500)]
+        for map_id, ex in [(0, ex0), (1, ex1)]:
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(records))
+            w.stop(True)
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+        for ex, (lo, hi) in [(ex0, (0, 1)), (ex1, (1, 2))]:
+            for _ in ex.get_reader(handle, lo, hi).read():
+                pass
+    finally:
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparkrdma_tpu.obs",
+        description="dump the unified metrics registry as JSON",
+    )
+    ap.add_argument(
+        "--demo", action="store_true",
+        help="run a small in-process cluster shuffle first so every "
+        "layer's counters populate",
+    )
+    ap.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="also export the span trace as Chrome trace-event JSON",
+    )
+    ap.add_argument(
+        "--prefix", default=None,
+        help="only include metrics whose name starts with this prefix "
+        "(e.g. 'transport.')",
+    )
+    ap.add_argument("--indent", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        _run_demo()
+    if args.trace_out:
+        export_chrome_trace(args.trace_out)
+    print(get_registry().to_json(prefix=args.prefix, indent=args.indent))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""Process-wide metrics registry: labeled counters, gauges, histograms.
+
+One registry per process (``get_registry()``); every layer of the
+shuffle stack registers named instruments against it and the e2e
+artifacts (``metrics_snapshot()``, bench records, the ``python -m
+sparkrdma_tpu.obs`` CLI) read a point-in-time ``snapshot()``.
+
+Conventions (see docs/OBSERVABILITY.md):
+
+- names are dotted ``layer.metric`` (``transport.sends``,
+  ``rpc.messages``, ``writer.spill_bytes``, ``mempool.hits``,
+  ``hbm.spill_victims``, ``reader.remote_bytes``,
+  ``exchange.bytes_sent``);
+- labels are low-cardinality key=value pairs (``role=exec-0``,
+  ``purpose=data``, ``type=FETCH_PARTITION_LOCATIONS``,
+  ``schedule=ring``);
+- snapshot keys render as ``name{k=v,...}`` with label keys sorted.
+
+Everything here is stdlib-only and import-cycle-free: the rest of the
+package may import this module unconditionally (including modules that
+must stay importable without jax).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+# Exponential-ish latency bounds in milliseconds; the last bucket in a
+# snapshot is the overflow (> bounds[-1]).
+DEFAULT_BOUNDS: Tuple[float, ...] = (
+    1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000,
+)
+
+
+def metric_key(name: str, labels: Mapping[str, str]) -> str:
+    """Canonical snapshot key: ``name`` or ``name{k=v,...}`` (sorted)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is the only mutator."""
+
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name: str, labels: Mapping[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value with a high-water mark."""
+
+    __slots__ = ("name", "labels", "_value", "_hwm", "_lock")
+
+    def __init__(self, name: str, labels: Mapping[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._value = 0
+        self._hwm = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+            if v > self._hwm:
+                self._hwm = v
+
+    def add(self, n) -> None:
+        with self._lock:
+            self._value += n
+            if self._value > self._hwm:
+                self._hwm = self._value
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def hwm(self):
+        return self._hwm
+
+
+class Histogram:
+    """Fixed-bound histogram (count/sum/min/max + per-bucket counts).
+
+    ``bounds`` are inclusive upper edges; one extra overflow bucket
+    catches everything above ``bounds[-1]``.
+    """
+
+    __slots__ = ("name", "labels", "bounds", "_counts", "_count", "_sum",
+                 "_min", "_max", "_lock")
+
+    def __init__(self, name: str, labels: Mapping[str, str],
+                 bounds: Sequence[float] = DEFAULT_BOUNDS):
+        self.name = name
+        self.labels = dict(labels)
+        self.bounds = tuple(bounds)
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        idx = len(self.bounds)
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            buckets = {}
+            for b, c in zip(self.bounds, self._counts):
+                buckets[f"le_{b:g}"] = c
+            buckets["overflow"] = self._counts[-1]
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "buckets": buckets,
+            }
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry of named, labeled instruments."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name: str, labels: Mapping[str, str],
+                       *extra):
+        key = metric_key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels, *extra)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {key!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get_or_create(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get_or_create(Gauge, name, labels)
+
+    def histogram(self, name: str, bounds: Sequence[float] = DEFAULT_BOUNDS,
+                  **labels: str) -> Histogram:
+        return self._get_or_create(Histogram, name, labels, bounds)
+
+    # -- read side --------------------------------------------------------
+    def _select(self, match: Optional[Mapping[str, str]],
+                prefix: Optional[str]) -> List[Tuple[str, object]]:
+        with self._lock:
+            items = list(self._metrics.items())
+        out = []
+        for key, m in items:
+            if prefix and not m.name.startswith(prefix):
+                continue
+            if match:
+                # A metric matches if every requested label either equals
+                # the requested value or is absent on the metric (shared /
+                # process-global instruments stay visible in role views).
+                labels = m.labels
+                if any(labels.get(k, v) != v for k, v in match.items()):
+                    continue
+            out.append((key, m))
+        return out
+
+    def snapshot(self, match: Optional[Mapping[str, str]] = None,
+                 prefix: Optional[str] = None) -> Dict[str, Dict[str, object]]:
+        """Point-in-time view: ``{"counters": {key: int}, "gauges":
+        {key: {"value", "hwm"}}, "histograms": {key: {...}}}``.
+
+        ``match`` filters by labels (metrics lacking a requested label
+        key are included); ``prefix`` filters by metric-name prefix.
+        """
+        snap = {"counters": {}, "gauges": {}, "histograms": {}}
+        for key, m in self._select(match, prefix):
+            if isinstance(m, Counter):
+                snap["counters"][key] = m.value
+            elif isinstance(m, Gauge):
+                snap["gauges"][key] = {"value": m.value, "hwm": m.hwm}
+            else:
+                snap["histograms"][key] = m.snapshot()
+        return snap
+
+    def delta(self, prev: Mapping[str, Mapping[str, object]],
+              match: Optional[Mapping[str, str]] = None,
+              prefix: Optional[str] = None) -> Dict[str, Dict[str, object]]:
+        """Change since a prior ``snapshot()``: counters and histogram
+        count/sum are differenced; gauges report their current state."""
+        cur = self.snapshot(match, prefix)
+        prev_c = prev.get("counters", {})
+        prev_h = prev.get("histograms", {})
+        out = {"counters": {}, "gauges": cur["gauges"], "histograms": {}}
+        for key, v in cur["counters"].items():
+            out["counters"][key] = v - prev_c.get(key, 0)
+        for key, h in cur["histograms"].items():
+            ph = prev_h.get(key, {})
+            out["histograms"][key] = {
+                "count": h["count"] - ph.get("count", 0),
+                "sum": h["sum"] - ph.get("sum", 0.0),
+                "min": h["min"],
+                "max": h["max"],
+            }
+        return out
+
+    def to_json(self, match: Optional[Mapping[str, str]] = None,
+                prefix: Optional[str] = None, indent: Optional[int] = None
+                ) -> str:
+        return json.dumps(self.snapshot(match, prefix), indent=indent,
+                          sort_keys=True)
+
+    def reset(self) -> None:
+        """Drop every registered instrument (tests only)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry all layers instrument against."""
+    return _DEFAULT

@@ -1,0 +1,229 @@
+"""Dapper-style span tracer with cross-executor trace correlation.
+
+A 64-bit trace id is minted when the driver registers a shuffle
+(``TpuShuffleManager.register_shuffle``) and rides inside the
+``PublishPartitionLocationsMsg`` / ``FetchPartitionLocationsMsg`` wire
+frames, so the publish → resolve → fetch spans of one shuffle share an
+id across every process role that touched it. Spans nest through a
+``contextvars`` context variable (thread- and task-local), and export
+as Chrome trace-event JSON (``{"traceEvents": [...]}``) loadable in
+Perfetto / chrome://tracing.
+
+Timestamps: spans record ``time.perf_counter()`` internally and are
+rebased to wall-clock microseconds at export via a module-load epoch,
+so spans from every tracer in the process share one timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+# Wall-clock anchor for the perf_counter timeline (export-time rebase).
+_EPOCH = time.time() - time.perf_counter()
+
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "sparkrdma_tpu_obs_span", default=None
+)
+
+_span_ids = itertools.count(1)
+_tracers_lock = threading.Lock()
+_tracers: "List[Tracer]" = []
+_named_lock = threading.Lock()
+_named: Dict[str, "Tracer"] = {}
+
+
+def now() -> float:
+    """Monotonic timestamp compatible with ``Tracer.record``."""
+    return time.perf_counter()
+
+
+def mint_trace_id() -> int:
+    """Random nonzero 63-bit trace id (0 means "unknown" on the wire)."""
+    return (int.from_bytes(os.urandom(8), "big") & 0x7FFFFFFFFFFFFFFF) | 1
+
+
+class Span:
+    __slots__ = ("name", "role", "trace_id", "span_id", "parent_id",
+                 "start", "end", "tid", "args")
+
+    def __init__(self, name: str, role: str, trace_id: int, parent_id: int,
+                 start: float, args: Dict[str, object]):
+        self.name = name
+        self.role = role
+        self.trace_id = trace_id
+        self.span_id = next(_span_ids)
+        self.parent_id = parent_id
+        self.start = start
+        self.end = start
+        self.tid = threading.get_ident()
+        self.args = args
+
+
+class Tracer:
+    """Per-role span recorder (one per shuffle manager / process role).
+
+    Spans live in a bounded deque (``max_spans``); ``bind_shuffle``
+    records the shuffle→trace-id association learned from the wire so
+    spans opened before the binding arrived (the reducer's fetch span)
+    can resolve their trace id at close time.
+    """
+
+    def __init__(self, role: str = "proc", max_spans: int = 20000,
+                 enabled: bool = True):
+        self.role = role
+        self.enabled = enabled
+        self._spans: "deque[Span]" = deque(maxlen=max(1, int(max_spans)))
+        self._lock = threading.Lock()
+        self._bindings: Dict[int, int] = {}
+        with _tracers_lock:
+            _tracers.append(self)
+
+    # -- shuffle → trace-id bindings --------------------------------------
+    def bind_shuffle(self, shuffle_id: int, trace_id: int) -> None:
+        if trace_id:
+            with self._lock:
+                self._bindings[shuffle_id] = trace_id
+
+    def trace_for(self, shuffle_id: Optional[int]) -> int:
+        if shuffle_id is None:
+            return 0
+        with self._lock:
+            return self._bindings.get(shuffle_id, 0)
+
+    # -- span recording ---------------------------------------------------
+    def _resolve_trace(self, trace_id: int, shuffle_id: Optional[int],
+                       parent: Optional[Span]) -> int:
+        if trace_id:
+            return trace_id
+        bound = self.trace_for(shuffle_id)
+        if bound:
+            return bound
+        return parent.trace_id if parent is not None else 0
+
+    @contextlib.contextmanager
+    def span(self, name: str, shuffle_id: Optional[int] = None,
+             trace_id: int = 0, **args):
+        """Context-managed span; nests under the current contextvar span.
+
+        The trace id is resolved eagerly at open (explicit arg, else the
+        shuffle binding, else the parent's id) so nested spans inherit
+        it, and re-resolved at close if still unknown — the binding may
+        arrive over the wire while the span is open."""
+        if not self.enabled:
+            yield None
+            return
+        parent = _current_span.get()
+        if shuffle_id is not None:
+            args.setdefault("shuffle_id", shuffle_id)
+        sp = Span(name, self.role,
+                  self._resolve_trace(trace_id, shuffle_id, parent),
+                  parent.span_id if parent is not None else 0,
+                  now(), args)
+        token = _current_span.set(sp)
+        try:
+            yield sp
+        finally:
+            _current_span.reset(token)
+            sp.end = now()
+            if not sp.trace_id:
+                sp.trace_id = self._resolve_trace(trace_id, shuffle_id, parent)
+            with self._lock:
+                self._spans.append(sp)
+
+    def record(self, name: str, start: float, end: float,
+               shuffle_id: Optional[int] = None, trace_id: int = 0,
+               **args) -> Optional[Span]:
+        """Retroactive span from already-measured ``now()`` timestamps
+        (hot paths that keep their own timers)."""
+        if not self.enabled:
+            return None
+        if shuffle_id is not None:
+            args.setdefault("shuffle_id", shuffle_id)
+        sp = Span(name, self.role, 0, 0, start, args)
+        sp.end = end
+        sp.trace_id = self._resolve_trace(trace_id, shuffle_id, None)
+        with self._lock:
+            self._spans.append(sp)
+        return sp
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._bindings.clear()
+
+
+def get_tracer(role: str = "proc") -> Tracer:
+    """Named-tracer convenience for code without a manager (benches)."""
+    with _named_lock:
+        t = _named.get(role)
+        if t is None:
+            t = Tracer(role=role)
+            _named[role] = t
+        return t
+
+
+def all_tracers() -> List[Tracer]:
+    with _tracers_lock:
+        return list(_tracers)
+
+
+def collect_spans(tracers: Optional[Iterable[Tracer]] = None) -> List[Span]:
+    out: List[Span] = []
+    for t in (tracers if tracers is not None else all_tracers()):
+        out.extend(t.spans())
+    out.sort(key=lambda s: s.start)
+    return out
+
+
+def to_chrome_trace(tracers: Optional[Iterable[Tracer]] = None) -> Dict:
+    """Chrome trace-event JSON dict: one complete event ("ph": "X") per
+    span, one pid per tracer role (with process_name metadata), tids
+    mapped to small ints per role."""
+    events: List[Dict] = []
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+    for sp in collect_spans(tracers):
+        pid = pids.setdefault(sp.role, len(pids) + 1)
+        tid = tids.setdefault((sp.role, sp.tid), len(tids) + 1)
+        args = dict(sp.args)
+        if sp.trace_id:
+            args["trace_id"] = f"{sp.trace_id:#x}"
+        if sp.parent_id:
+            args["parent_span"] = sp.parent_id
+        events.append({
+            "name": sp.name,
+            "cat": "shuffle",
+            "ph": "X",
+            "ts": (_EPOCH + sp.start) * 1e6,
+            "dur": max(0.0, (sp.end - sp.start) * 1e6),
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": role}}
+        for role, pid in sorted(pids.items(), key=lambda kv: kv[1])
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str,
+                        tracers: Optional[Iterable[Tracer]] = None) -> Dict:
+    """Write the Chrome trace JSON to ``path`` and return the dict."""
+    doc = to_chrome_trace(tracers)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return doc

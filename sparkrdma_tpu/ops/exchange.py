@@ -40,10 +40,11 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.parallel.mesh import shard_spec
+from sparkrdma_tpu.utils.jax_compat import shard_map
 
 MIN_BUCKET = 1024
 
@@ -143,15 +144,22 @@ class ExchangeProgram:
             valid = int(
                 sum(np.asarray(s.data).sum() for s in rcounts.addressable_shards)
             )
+        recv_cap = recv.size * jnp.dtype(recv.dtype).itemsize
         s = self.stats[label]
         s["exchanges"] += 1
         s["bytes_sent"] += cap
         # measured from the landed array, independently of the send side
-        s["bytes_received"] += recv.size * jnp.dtype(recv.dtype).itemsize
+        s["bytes_received"] += recv_cap
         s["bytes_received_valid"] += valid
         s["time_s"] += dt
         self.exchanges += 1
         self.bytes_moved += cap
+        reg = get_registry()
+        reg.counter("exchange.exchanges", schedule=label).inc()
+        reg.counter("exchange.bytes_sent", schedule=label).inc(cap)
+        reg.counter("exchange.bytes_received", schedule=label).inc(recv_cap)
+        reg.counter("exchange.bytes_received_valid", schedule=label).inc(valid)
+        reg.histogram("exchange.time_ms", schedule=label).observe(dt * 1e3)
         return recv, rcounts
 
     def _placed(self, send, counts):

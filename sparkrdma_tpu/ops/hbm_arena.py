@@ -36,7 +36,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparkrdma_tpu.obs import get_registry
+
 logger = logging.getLogger(__name__)
+
+_M_POOL_HITS = get_registry().counter("hbm.pool_hits")
+_M_POOL_MISSES = get_registry().counter("hbm.pool_misses")
+_M_SPILL_VICTIMS = get_registry().counter("hbm.spill_victims")
+_M_DISK_SPILLS = get_registry().counter("hbm.disk_spills")
+# summed across managers; the gauge's high-water mark is the figure of
+# interest for sizing hbm.maxBytes
+_G_IN_USE = get_registry().gauge("hbm.in_use_bytes")
 
 MIN_BLOCK_SIZE = 16 * 1024  # RdmaBufferManager.java MIN_BLOCK_SIZE analogue
 
@@ -375,6 +385,8 @@ class DeviceBufferManager:
             self._in_use_bytes -= buf.capacity
             self._host_bytes += buf.capacity
             self._spill_count += 1
+        _G_IN_USE.add(-buf.capacity)
+        _M_SPILL_VICTIMS.inc()
         with self._evict_cond:
             self._evict_cond.notify_all()
 
@@ -382,6 +394,7 @@ class DeviceBufferManager:
         with self._lock:
             self._host_bytes -= buf.capacity
             self._disk_spill_count += 1
+        _M_DISK_SPILLS.inc()
 
     def _pick_host_victim(self, exclude_handle: int) -> Optional[DeviceBuffer]:
         with self._lock:
@@ -482,6 +495,7 @@ class DeviceBufferManager:
             self._host_bytes -= buf.capacity  # leaving the host tier
             self._use_clock += 1
             buf.last_use = self._use_clock
+        _G_IN_USE.add(buf.capacity)
 
     @contextlib.contextmanager
     def pinned_on_device(self, bufs):
@@ -571,10 +585,13 @@ class DeviceBufferManager:
                 self._use_clock += 1
                 pooled.last_use = self._use_clock
         if pooled is not None:
+            _M_POOL_HITS.inc()
+            _G_IN_USE.add(cls)
             # the pooled slab re-enters the budget: spill LRU others if
             # that pushed us over the cap
             self._make_room(0, {pooled.handle})
             return pooled
+        _M_POOL_MISSES.inc()
         self._make_room(cls)
         with self._lock:
             handle = self._next_handle
@@ -585,6 +602,7 @@ class DeviceBufferManager:
             # table: concurrent _make_room callers must WAIT for it to
             # materialize, not conclude the pool is unspillable
             self._allocating += 1
+        _G_IN_USE.add(cls)
         try:
             arr = jax.device_put(jnp.zeros((cls,), dtype=jnp.uint8), self.device)
             buf = DeviceBuffer(handle, cls, arr, self)
@@ -638,6 +656,7 @@ class DeviceBufferManager:
                     buf.array.delete()
                 else:
                     self._stacks[buf.capacity].stack.append(buf)
+            _G_IN_USE.add(-buf.capacity)
             with self._evict_cond:
                 self._evict_cond.notify_all()
             if not stopped:

@@ -100,12 +100,21 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # relies on Spark's own MapOutputTracker for this barrier; here the
     # control plane owns it). 0 on driver→reducer replies.
     num_map_outputs: int = 0
+    # observability: the shuffle's trace id (minted at register_shuffle,
+    # obs/trace.py) rides the frame so spans correlate across roles.
+    # 0 = unknown (e.g. writer publishes before learning the id). It is
+    # appended as a trailing 8-byte extension AFTER the locations so
+    # parsers of the original layout (examples/foreign_client.c) skip
+    # it: a PartitionLocation is >= 28 bytes, so an 8-byte residue is
+    # unambiguously the extension, never a truncated location.
+    trace_id: int = 0
 
     # is_last(1) shuffle_id(4) partition_id(4) num_map_outputs(4)
     _HDR = struct.Struct(">Biii")
+    _TRACE_EXT = struct.Struct(">Q")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
-        budget = seg_size - SEG_HEADER.size - self._HDR.size
+        budget = seg_size - SEG_HEADER.size - self._HDR.size - self._TRACE_EXT.size
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
         groups: List[List[PartitionLocation]] = [[]]
@@ -135,6 +144,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             )
             for loc in group:
                 loc.write(buf)
+            buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
 
@@ -146,9 +156,14 @@ class PublishPartitionLocationsMsg(RpcMsg):
         )
         locs = []
         end = len(payload)
-        while inp.tell() < end:
+        # locations are each >= 28 bytes, so a residue of exactly 8 is
+        # the trailing trace-id extension (absent from legacy senders)
+        while end - inp.tell() > cls._TRACE_EXT.size:
             locs.append(PartitionLocation.read(inp))
-        return cls(shuffle_id, partition_id, locs, bool(is_last), num_maps)
+        trace_id = 0
+        if end - inp.tell() == cls._TRACE_EXT.size:
+            (trace_id,) = cls._TRACE_EXT.unpack(inp.read(cls._TRACE_EXT.size))
+        return cls(shuffle_id, partition_id, locs, bool(is_last), num_maps, trace_id)
 
 
 @dataclass
@@ -167,11 +182,23 @@ class FetchPartitionLocationsMsg(RpcMsg):
     shuffle_id: int
     start_partition: int
     end_partition: int
+    # observability: propagated shuffle trace id (0 = unknown). Sent as
+    # a trailing 8-byte extension after the legacy 12-byte body; legacy
+    # senders (examples/foreign_client.c) omit it and parse as trace 0.
+    trace_id: int = 0
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         buf = BytesIO()
         self.requester.write(buf)
-        buf.write(struct.pack(">iii", self.shuffle_id, self.start_partition, self.end_partition))
+        buf.write(
+            struct.pack(
+                ">iiiQ",
+                self.shuffle_id,
+                self.start_partition,
+                self.end_partition,
+                self.trace_id,
+            )
+        )
         seg = self.frame(self.msg_type, buf.getvalue())
         if len(seg) > seg_size:
             raise ValueError("fetch message exceeds one segment")
@@ -181,8 +208,10 @@ class FetchPartitionLocationsMsg(RpcMsg):
     def from_payload(cls, payload: bytes) -> "FetchPartitionLocationsMsg":
         inp = BytesIO(payload)
         requester = ShuffleManagerId.read(inp)
-        shuffle_id, start, end = struct.unpack(">iii", inp.read(12))
-        return cls(requester, shuffle_id, start, end)
+        rest = inp.read()
+        shuffle_id, start, end = struct.unpack_from(">iii", rest, 0)
+        trace_id = struct.unpack_from(">Q", rest, 12)[0] if len(rest) >= 20 else 0
+        return cls(requester, shuffle_id, start, end, trace_id)
 
 
 @dataclass

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.ops.hbm_arena import (
     DeviceBuffer,
     DeviceBufferManager,
@@ -372,6 +373,10 @@ class DeviceShuffleIO:
                 self._fetch_stats["fetch_transport_s"] += t_transport
                 self._fetch_stats["fetch_stage_s"] += t_stage
                 self._fetch_stats["fetch_bytes"] += n_bytes
+            reg = get_registry()
+            reg.histogram("device_fetch.transport_ms").observe(t_transport * 1e3)
+            reg.histogram("device_fetch.stage_ms").observe(t_stage * 1e3)
+            reg.counter("device_fetch.bytes").inc(n_bytes)
 
     # ------------------------------------------------------------------
     def metrics_snapshot(self) -> dict:

@@ -38,6 +38,8 @@ from typing import BinaryIO, Dict, List, Optional, Tuple
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs import now as obs_now
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
 from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
 
@@ -98,6 +100,16 @@ class TpuShuffleFetcherIterator:
         self.start_partition = start_partition
         self.end_partition = end_partition
         self.metrics = ShuffleMetrics()
+
+        # registry mirrors of ShuffleMetrics, pre-resolved per iterator
+        role = manager.executor_id
+        reg = get_registry()
+        self._m_local_blocks = reg.counter("reader.local_blocks", role=role)
+        self._m_local_bytes = reg.counter("reader.local_bytes", role=role)
+        self._m_remote_blocks = reg.counter("reader.remote_blocks", role=role)
+        self._m_remote_bytes = reg.counter("reader.remote_bytes", role=role)
+        self._m_fetch_wait_ms = reg.counter("reader.fetch_wait_ms", role=role)
+        self._h_fetch_ms = reg.histogram("reader.fetch_ms", role=role)
 
         self._results: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
@@ -166,6 +178,16 @@ class TpuShuffleFetcherIterator:
             ):
                 local_streams.append((pid, stream))
                 self.metrics.local_blocks += 1
+        # local bytes from the published block lengths (the streams
+        # themselves are opaque); mirrors remote_bytes accounting
+        local_bytes = sum(
+            loc.block.length
+            for loc in locations
+            if loc.manager_id.executor_id == my_id
+        )
+        self.metrics.local_bytes += local_bytes
+        self._m_local_blocks.inc(len(local_streams))
+        self._m_local_bytes.inc(local_bytes)
         if local_streams:
             with self._lock:
                 self._total_results += 1
@@ -233,17 +255,33 @@ class TpuShuffleFetcherIterator:
     def _deliver_group(self, mid, group, streams, t0) -> None:
         """Shared success epilogue: histogram, metrics, closed-aware
         enqueue — ONE definition for both delivery flavors."""
+        t1 = obs_now()
+        latency_ms = (t1 - t0) * 1e3
         stats = self._manager.reader_stats
         if stats is not None:
-            stats.update_remote_fetch_histogram(mid, (time.monotonic() - t0) * 1e3)
+            stats.update_remote_fetch_histogram(mid, latency_ms)
         self.metrics.remote_blocks += len(streams)
         self.metrics.remote_bytes += group.total_length
+        self._m_remote_blocks.inc(len(streams))
+        self._m_remote_bytes.inc(group.total_length)
+        self._h_fetch_ms.observe(latency_ms)
+        # fetch span: the trace id arrived with the location reply, so
+        # the binding is resolvable by now
+        self._manager.tracer.record(
+            "shuffle.fetch",
+            t0,
+            t1,
+            shuffle_id=self._handle.shuffle_id,
+            peer=mid.executor_id,
+            bytes=group.total_length,
+            blocks=len(streams),
+        )
         self._put_success(streams, group.total_length)
 
     def _fetch_blocks(self, fetch: _PendingFetch) -> None:
         """Issue one one-sided READ for a whole group (:132-218)."""
         mid, group = fetch.manager_id, fetch.group
-        t0 = time.monotonic()
+        t0 = obs_now()
         try:
             # bulk READ payloads ride the data-flavor channel so an 8 MiB
             # in-flight group never head-of-line blocks a location fetch
@@ -387,7 +425,9 @@ class TpuShuffleFetcherIterator:
                 raise StopIteration
             t0 = time.monotonic()
             result = self._results.get()
-            self.metrics.fetch_wait_ms += (time.monotonic() - t0) * 1e3
+            waited_ms = (time.monotonic() - t0) * 1e3
+            self.metrics.fetch_wait_ms += waited_ms
+            self._m_fetch_wait_ms.inc(waited_ms)
             with self._lock:
                 self._processed_results += 1
                 self._bytes_in_flight -= result.in_flight

@@ -23,10 +23,13 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
+from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
+from sparkrdma_tpu.obs import now as obs_now
 from sparkrdma_tpu.rpc import (
     AnnounceManagersMsg,
     FetchPartitionLocationsMsg,
@@ -91,6 +94,18 @@ class TpuShuffleManager:
             ShuffleReaderStats(conf) if conf.collect_shuffle_read_stats else None
         )
 
+        # observability: process-wide registry + per-role tracer. Reader
+        # ShuffleMetrics objects are retained (they are tiny dataclasses
+        # with no back-references) so metrics_snapshot() can aggregate
+        # the read path even after readers are dropped.
+        self.registry = get_registry()
+        self.tracer = Tracer(
+            role=self.executor_id,
+            max_spans=conf.trace_max_spans,
+            enabled=conf.trace_enabled,
+        )
+        self._reader_metrics: List[object] = []
+
         if is_driver:
             # driver starts its node eagerly and records the negotiated
             # port for executors (:180-184)
@@ -147,6 +162,7 @@ class TpuShuffleManager:
     # RPC dispatch (reference receiveListener, :65-178)
     # ------------------------------------------------------------------
     def _receive_listener(self, channel, payload: bytes) -> None:
+        t0 = time.perf_counter()
         try:
             msg = RpcMsg.parse_segment(payload)
             if isinstance(msg, ManagerHelloMsg):
@@ -158,7 +174,16 @@ class TpuShuffleManager:
             elif isinstance(msg, AnnounceManagersMsg):
                 self._handle_announce(msg)
         except Exception:
+            self.registry.counter("rpc.errors", role=self.executor_id).inc()
             logger.exception("error dispatching rpc message")
+        else:
+            mtype = msg.msg_type.name
+            self.registry.counter(
+                "rpc.messages", role=self.executor_id, type=mtype
+            ).inc()
+            self.registry.histogram(
+                "rpc.handle_ms", role=self.executor_id, type=mtype
+            ).observe((time.perf_counter() - t0) * 1e3)
 
     def _handle_hello(self, msg: ManagerHelloMsg) -> None:
         """Driver: record membership, connect back, announce to all (:121-161)."""
@@ -219,22 +244,46 @@ class TpuShuffleManager:
         self._reply_fetch(msg)
 
     def _reply_fetch(self, msg: FetchPartitionLocationsMsg) -> None:
-        locs: List[PartitionLocation] = []
-        with self._lock:
-            shuffle = self._partition_locations.get(msg.shuffle_id)
-            if shuffle is not None:
-                for pid in range(msg.start_partition, msg.end_partition):
-                    locs.extend(shuffle.get(pid, ()))
-        reply = PublishPartitionLocationsMsg(msg.shuffle_id, msg.start_partition, locs)
-        assert self.node is not None
-        try:
-            ch = self.node.get_channel(msg.requester.host, msg.requester.port)
-            ch.send_in_queue(FnListener(), reply.to_segments(self.conf.recv_wr_size))
-        except IOError:
-            logger.warning("publish reply to %s failed", msg.requester)
+        with self.tracer.span(
+            "shuffle.resolve",
+            shuffle_id=msg.shuffle_id,
+            trace_id=msg.trace_id,
+            requester=msg.requester.executor_id,
+            partitions=f"{msg.start_partition}:{msg.end_partition}",
+        ):
+            locs: List[PartitionLocation] = []
+            with self._lock:
+                shuffle = self._partition_locations.get(msg.shuffle_id)
+                if shuffle is not None:
+                    for pid in range(msg.start_partition, msg.end_partition):
+                        locs.extend(shuffle.get(pid, ()))
+            reply = PublishPartitionLocationsMsg(
+                msg.shuffle_id,
+                msg.start_partition,
+                locs,
+                trace_id=self.tracer.trace_for(msg.shuffle_id) or msg.trace_id,
+            )
+            assert self.node is not None
+            try:
+                ch = self.node.get_channel(msg.requester.host, msg.requester.port)
+                ch.send_in_queue(FnListener(), reply.to_segments(self.conf.recv_wr_size))
+            except IOError:
+                logger.warning("publish reply to %s failed", msg.requester)
 
     def _handle_publish(self, msg: PublishPartitionLocationsMsg) -> None:
         if self.is_driver:
+            if msg.is_last and msg.partition_id < 0:
+                # one span per completed writer publish (not per segment)
+                t = obs_now()
+                self.tracer.record(
+                    "shuffle.publish",
+                    t,
+                    t,
+                    shuffle_id=msg.shuffle_id,
+                    trace_id=msg.trace_id,
+                    locations=len(msg.locations),
+                    map_outputs=msg.num_map_outputs,
+                )
             # writers publish with partition_id = -1; re-key every location
             # by its own partition id (:68-95)
             to_reply: List[FetchPartitionLocationsMsg] = []
@@ -260,6 +309,7 @@ class TpuShuffleManager:
                 self._reply_fetch(fetch)
             return
         # executor: location-fetch responses, accumulated until is_last
+        self.tracer.bind_shuffle(msg.shuffle_id, msg.trace_id)
         key = (msg.shuffle_id, msg.partition_id)
         with self._lock:
             self._fetch_acc.setdefault(key, []).extend(msg.locations)
@@ -308,14 +358,25 @@ class TpuShuffleManager:
         num_map_outputs: int = 0,
     ) -> None:
         msg = PublishPartitionLocationsMsg(
-            shuffle_id, partition_id, locations, num_map_outputs=num_map_outputs
+            shuffle_id,
+            partition_id,
+            locations,
+            num_map_outputs=num_map_outputs,
+            trace_id=self.tracer.trace_for(shuffle_id),
+        )
+        self.registry.counter("writer.publishes", role=self.executor_id).inc()
+        self.registry.counter("writer.locations_published", role=self.executor_id).inc(
+            len(locations)
         )
         if self.is_driver:
             self._handle_publish(msg)
             return
         assert self.node is not None
-        ch = self.node.get_channel(self.conf.driver_host, self.conf.driver_port)
-        ch.send_in_queue(FnListener(), msg.to_segments(self.conf.recv_wr_size))
+        with self.tracer.span(
+            "shuffle.publish", shuffle_id=shuffle_id, locations=len(locations)
+        ):
+            ch = self.node.get_channel(self.conf.driver_host, self.conf.driver_port)
+            ch.send_in_queue(FnListener(), msg.to_segments(self.conf.recv_wr_size))
 
     def fetch_remote_partition_locations(
         self, shuffle_id: int, start_partition: int, end_partition: int
@@ -327,7 +388,11 @@ class TpuShuffleManager:
             self._fetch_futures[key] = future
             self._fetch_acc.pop(key, None)
         msg = FetchPartitionLocationsMsg(
-            self.local_manager_id, shuffle_id, start_partition, end_partition
+            self.local_manager_id,
+            shuffle_id,
+            start_partition,
+            end_partition,
+            trace_id=self.tracer.trace_for(shuffle_id),
         )
         assert self.node is not None
 
@@ -379,6 +444,17 @@ class TpuShuffleManager:
                 handle.shuffle_id,
                 {pid: [] for pid in range(handle.num_partitions)},
             )
+        # mint the shuffle's trace id; it rides every Publish/Fetch frame
+        # touching this shuffle so spans correlate across roles
+        trace_id = mint_trace_id()
+        self.tracer.bind_shuffle(handle.shuffle_id, trace_id)
+        with self.tracer.span(
+            "shuffle.register",
+            shuffle_id=handle.shuffle_id,
+            num_maps=handle.num_maps,
+            num_partitions=handle.num_partitions,
+        ):
+            pass
         return handle
 
     def get_writer(self, handle: BaseShuffleHandle, map_id: int):
@@ -394,7 +470,10 @@ class TpuShuffleManager:
         from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
 
         self.start_node_if_missing()
-        return TpuShuffleReader(self, handle, start_partition, end_partition)
+        reader = TpuShuffleReader(self, handle, start_partition, end_partition)
+        with self._lock:
+            self._reader_metrics.append(reader.metrics)
+        return reader
 
     def finalize_maps(self, shuffle_id: int) -> None:
         """Map-stage barrier hook: chunked-agg data publishes here."""
@@ -448,6 +527,27 @@ class TpuShuffleManager:
                 snap["reads_streamed"] = streamed
         if self.reader_stats is not None:
             snap["fetch_latency_histograms"] = self.reader_stats.snapshot()
+        # read-path ShuffleMetrics aggregated over every reader this
+        # manager created (live + finished)
+        agg = {
+            "local_blocks": 0,
+            "remote_blocks": 0,
+            "local_bytes": 0,
+            "remote_bytes": 0,
+            "fetch_wait_ms": 0,
+            "records_read": 0,
+            "sort_spills": 0,
+        }
+        with self._lock:
+            readers = list(self._reader_metrics)
+        for m in readers:
+            for k in agg:
+                agg[k] += getattr(m, k, 0)
+        snap["shuffle_read"] = agg
+        # the unified registry view: every instrument whose labels are
+        # compatible with this manager's role (process-global metrics
+        # without a role label are included)
+        snap["registry"] = self.registry.snapshot(match={"role": self.executor_id})
         return snap
 
     def stop(self) -> None:

@@ -14,6 +14,7 @@ import threading
 from typing import Dict, List
 
 from sparkrdma_tpu.locations import ShuffleManagerId
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.utils.config import TpuShuffleConf
 
 logger = logging.getLogger(__name__)
@@ -23,12 +24,19 @@ class RemoteFetchHistogram:
     """Fixed-bucket latency histogram (reference :25-46)."""
 
     def __init__(self, num_buckets: int, bucket_size_ms: int):
-        self.num_buckets = num_buckets
-        self.bucket_size_ms = bucket_size_ms
-        self._buckets = [0] * (num_buckets + 1)  # +1 overflow bucket
+        # clamp degenerate shapes instead of deferring the blow-up to
+        # add(): bucket_size_ms <= 0 was a ZeroDivisionError there
+        self.num_buckets = max(1, int(num_buckets))
+        self.bucket_size_ms = max(1, int(bucket_size_ms))
+        self._buckets = [0] * (self.num_buckets + 1)  # +1 overflow bucket
         self._lock = threading.Lock()
 
     def add(self, latency_ms: float) -> None:
+        # negative latencies (clock skew between timers) floor-divide to
+        # a negative index — i.e. silently count in the overflow bucket
+        # via Python's negative indexing; clamp them into bucket 0
+        if latency_ms < 0:
+            latency_ms = 0.0
         idx = min(int(latency_ms // self.bucket_size_ms), self.num_buckets)
         with self._lock:
             self._buckets[idx] += 1
@@ -64,6 +72,11 @@ class ShuffleReaderStats:
                 hist = RemoteFetchHistogram(self._num_buckets, self._bucket_size_ms)
                 self._per_remote[remote] = hist
         hist.add(latency_ms)
+        # mirror into the unified registry so snapshots see the same
+        # distribution without opting into reader_stats
+        get_registry().histogram(
+            "reader.remote_fetch_ms", peer=remote.executor_id
+        ).observe(latency_ms)
 
     def snapshot(self) -> Dict[str, List[int]]:
         """Live queryable form of what ``print_stats`` logs at stop:

@@ -38,6 +38,7 @@ from typing import BinaryIO, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu.engine.serializer import frame_compressed
 from sparkrdma_tpu.locations import PartitionLocation
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, combine_by_key
 from sparkrdma_tpu.shuffle.writer import ShuffleData
 from sparkrdma_tpu.shuffle.writer.chunked_buffer import ChunkedByteBufferOutputStream
@@ -129,6 +130,12 @@ class ChunkedAggShuffleData(ShuffleData):
         for pid, pw in writers.items():
             for block_loc in pw.locations():
                 locs.append(PartitionLocation(manager.local_manager_id, pid, block_loc))
+        reg = get_registry()
+        role = manager.executor_id
+        reg.counter("writer.map_outputs", role=role, method="chunked_agg").inc(
+            committed
+        )
+        reg.counter("writer.partitions_written", role=role).inc(len(writers))
         manager.publish_partition_locations(
             self.shuffle_id, -1, locs, num_map_outputs=committed
         )
@@ -193,6 +200,10 @@ class ChunkedAggShuffleWriter:
         self._data.partition_writer(pid).append_frame(framed)
         self._lengths[pid] += len(framed)
         self._dirty = True
+        reg = get_registry()
+        role = self._manager.executor_id
+        reg.counter("writer.partition_flushes", role=role).inc()
+        reg.counter("writer.flush_bytes", role=role).inc(len(framed))
 
     def write(self, records) -> None:
         part = self._handle.partitioner.partition

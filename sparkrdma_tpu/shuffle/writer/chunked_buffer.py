@@ -13,6 +13,10 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Tuple
 
 from sparkrdma_tpu.memory.buffer import TpuBuffer
+from sparkrdma_tpu.obs import get_registry
+
+_M_CHUNK_ALLOCS = get_registry().counter("writer.chunk_allocations")
+_M_CHUNK_RECYCLES = get_registry().counter("writer.chunk_recycles")
 
 
 class ChunkedByteBuffer:
@@ -75,9 +79,12 @@ class ChunkedByteBufferOutputStream:
         written = 0
         while written < len(mv):
             if not self._chunks or self._pos_in_chunk == self.chunk_size:
-                self._chunks.append(
-                    self._recycled.pop() if self._recycled else self._allocate(self.chunk_size)
-                )
+                if self._recycled:
+                    _M_CHUNK_RECYCLES.inc()
+                    self._chunks.append(self._recycled.pop())
+                else:
+                    _M_CHUNK_ALLOCS.inc()
+                    self._chunks.append(self._allocate(self.chunk_size))
                 self._pos_in_chunk = 0
             chunk = self._chunks[-1]
             n = min(len(mv) - written, self.chunk_size - self._pos_in_chunk)

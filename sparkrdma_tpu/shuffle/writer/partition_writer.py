@@ -22,11 +22,16 @@ from typing import BinaryIO, List
 
 from sparkrdma_tpu.locations import BlockLocation
 from sparkrdma_tpu.memory.registry import ProtectionDomain
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.shuffle.writer.blocks import (
     FileWriterBlock,
     MemoryWriterBlock,
     WriterBlock,
 )
+
+_M_MEM_BLOCKS = get_registry().counter("writer.blocks_memory")
+_M_SPILL_BLOCKS = get_registry().counter("writer.blocks_spilled")
+_M_SPILL_BYTES = get_registry().counter("writer.spill_bytes")
 
 
 class PartitionWriter:
@@ -44,12 +49,15 @@ class PartitionWriter:
         if self._resolver.reserve_inmemory_bytes(capacity):
             block = MemoryWriterBlock(pd, capacity)
             block.reserved_bytes = capacity
+            _M_MEM_BLOCKS.inc()
             return block
         path = self._resolver.scratch_path(
             f"shuffle_{self.shuffle_id}_p{self.partition_id}_b{len(self._blocks)}"
         )
         block = FileWriterBlock(pd, capacity, path)
         block.reserved_bytes = 0
+        _M_SPILL_BLOCKS.inc()
+        _M_SPILL_BYTES.inc(capacity)
         return block
 
     def append_frame(self, framed) -> int:

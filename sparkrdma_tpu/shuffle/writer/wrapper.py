@@ -25,6 +25,7 @@ from typing import BinaryIO, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu.locations import PartitionLocation
 from sparkrdma_tpu.memory.mapped_file import MappedFile
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
 from sparkrdma_tpu.shuffle.writer import ShuffleData
@@ -127,6 +128,11 @@ class WrapperShuffleWriter:
             for pid in range(self._handle.num_partitions)
             if mf.get_partition_location(pid).length > 0
         ]
+        role = self._manager.executor_id
+        reg = get_registry()
+        reg.counter("writer.map_outputs", role=role, method="wrapper").inc()
+        reg.counter("writer.partitions_written", role=role).inc(len(locs))
+        reg.counter("writer.bytes_written", role=role).inc(sum(self._lengths))
         self._manager.publish_partition_locations(
             self._handle.shuffle_id, -1, locs, num_map_outputs=1
         )

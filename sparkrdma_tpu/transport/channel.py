@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from sparkrdma_tpu.memory.registry import ProtectionDomain, RegionError
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.transport import wire
 from sparkrdma_tpu.transport.completion import CompletionListener
 from sparkrdma_tpu.utils.config import TpuShuffleConf
@@ -78,10 +79,12 @@ class TpuChannel:
         on_recv=None,
         on_disconnect=None,
         cpu_vector: Optional[int] = None,
+        purpose: str = "rpc",
     ):
         self.conf = conf
         self.pd = pd
         self.peer_desc = peer_desc
+        self.purpose = purpose
         self._sock = sock
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._on_recv = on_recv
@@ -98,6 +101,24 @@ class TpuChannel:
         self._stopped = False
         self._cpu_vector = cpu_vector
 
+        # counters pre-resolved once per channel so the hot verb paths
+        # never pay a registry lookup (labels: connection purpose)
+        reg = get_registry()
+        self._m_sends = reg.counter("transport.sends", purpose=purpose)
+        self._m_send_bytes = reg.counter("transport.send_bytes", purpose=purpose)
+        self._m_recvs = reg.counter("transport.recvs", purpose=purpose)
+        self._m_recv_bytes = reg.counter("transport.recv_bytes", purpose=purpose)
+        self._m_reads = reg.counter("transport.reads", purpose=purpose)
+        self._m_read_bytes = reg.counter("transport.read_bytes", purpose=purpose)
+        self._m_reads_served = reg.counter("transport.reads_served", purpose=purpose)
+        self._m_read_bytes_served = reg.counter(
+            "transport.read_bytes_served", purpose=purpose
+        )
+        self._m_completions = reg.counter("transport.completions", purpose=purpose)
+        self._m_read_errors = reg.counter("transport.read_errors", purpose=purpose)
+        self._m_overflow = reg.counter("transport.send_overflow", purpose=purpose)
+        self._m_errors = reg.counter("transport.errors_latched", purpose=purpose)
+
         self._recv_thread = threading.Thread(
             target=self._process_completions, name=f"cq-{peer_desc}", daemon=True
         )
@@ -109,6 +130,8 @@ class TpuChannel:
     def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
         """Post RPC segments as SEND WRs; one completion for the batch."""
         payloads = [wire.pack_send(seg) for seg in segments]
+        self._m_sends.inc(len(payloads))
+        self._m_send_bytes.inc(sum(len(p) for p in payloads))
         wr = _QueuedWr(kind="send", permits=len(payloads), payloads=payloads, listener=listener)
         self._post(wr)
 
@@ -127,6 +150,8 @@ class TpuChannel:
         total = sum(b[2] for b in blocks)
         if sum(len(v) for v in dst_views) != total:
             raise ValueError("destination size != total remote block length")
+        self._m_reads.inc(len(blocks))
+        self._m_read_bytes.inc(total)
         wr = _QueuedWr(
             kind="read",
             permits=max(1, len(blocks)),
@@ -149,6 +174,7 @@ class TpuChannel:
             if self._send_budget >= wr.permits:
                 self._send_budget -= wr.permits
             else:
+                self._m_overflow.inc()
                 if not self._warned_oversubscription:
                     self._warned_oversubscription = True
                     logger.warning(
@@ -229,6 +255,8 @@ class TpuChannel:
                 if op == wire.OP_SEND:
                     n = struct.unpack(">I", wire.read_exact(self._sock, 4))[0]
                     payload = wire.read_exact(self._sock, n)
+                    self._m_recvs.inc()
+                    self._m_recv_bytes.inc(n)
                     if self._on_recv is not None:
                         self._on_recv(self, payload)
                 elif op == wire.OP_READ_REQ or op == wire.OP_READ_REQ2:
@@ -267,6 +295,8 @@ class TpuChannel:
                 self._sock.sendall(wire.pack_read_err(req_id, str(e)))
             return
         total = sum(len(v) for v in views)
+        self._m_reads_served.inc(len(views))
+        self._m_read_bytes_served.inc(total)
         with self._write_lock:
             self._sock.sendall(wire.pack_read_resp_header(req_id, total))
             for v in views:
@@ -296,6 +326,7 @@ class TpuChannel:
                 except Exception:
                     logger.exception("listener on_failure raised")
             raise
+        self._m_completions.inc()
         self._reclaim(pending.permits)
         if pending.listener:
             pending.listener.on_success(total)
@@ -307,6 +338,7 @@ class TpuChannel:
         with self._state_lock:
             pending = self._pending_reads.pop(req_id, None)
         if pending is not None:
+            self._m_read_errors.inc()
             self._reclaim(pending.permits)
             if pending.listener:
                 pending.listener.on_failure(ChannelError(f"remote READ failed: {msg}"))
@@ -319,6 +351,7 @@ class TpuChannel:
             if self._error is not None:
                 return
             self._error = err
+            self._m_errors.inc()
             pending = list(self._pending_reads.values())
             self._pending_reads.clear()
             overflow = list(self._overflow)

@@ -29,6 +29,7 @@ import numpy as np
 
 from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
 from sparkrdma_tpu.native import transport_lib as tl
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.transport import wire
 from sparkrdma_tpu.transport.channel import ChannelError
 from sparkrdma_tpu.transport.completion import CompletionListener
@@ -193,15 +194,26 @@ class NativeTpuChannel:
     acquire permits queue in an overflow deque drained as completions
     reclaim, with a one-time oversubscription warning."""
 
-    def __init__(self, node: "NativeTpuNode", channel_id: int, peer_desc: str):
+    def __init__(self, node: "NativeTpuNode", channel_id: int, peer_desc: str,
+                 purpose: str = "rpc"):
         self._node = node
         self.channel_id = channel_id
         self.peer_desc = peer_desc
+        self.purpose = purpose
         self._dead = threading.Event()
         self._budget = node.conf.send_queue_depth
         self._budget_lock = threading.Lock()
         self._overflow: "list" = []
         self._warned_oversubscription = False
+        # same metric names as the pure-Python TpuChannel so registry
+        # views stay transport-agnostic; per-byte completions live in
+        # the C++ loop, so only the Python-visible verbs are counted
+        reg = get_registry()
+        self._m_sends = reg.counter("transport.sends", purpose=purpose)
+        self._m_send_bytes = reg.counter("transport.send_bytes", purpose=purpose)
+        self._m_reads = reg.counter("transport.reads", purpose=purpose)
+        self._m_read_bytes = reg.counter("transport.read_bytes", purpose=purpose)
+        self._m_overflow = reg.counter("transport.send_overflow", purpose=purpose)
 
     def _acquire_or_queue(self, permits: int, item) -> bool:
         with self._budget_lock:
@@ -215,6 +227,7 @@ class NativeTpuChannel:
                     "tpu.shuffle.sendQueueDepth (current %d)",
                     self.peer_desc, self._node.conf.send_queue_depth,
                 )
+            self._m_overflow.inc()
             self._overflow.append(item)
             return False
 
@@ -250,6 +263,8 @@ class NativeTpuChannel:
     # -- verb API (parity with TpuChannel) -----------------------------
     def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
         segments = [bytes(s) for s in segments]
+        self._m_sends.inc(len(segments))
+        self._m_send_bytes.inc(sum(len(s) for s in segments))
         permits = max(1, len(segments))
         wrapped = self._wrap_reclaim(listener, permits)
         post = lambda: self._node._post_send(self, wrapped, segments)
@@ -265,6 +280,8 @@ class NativeTpuChannel:
         total = sum(b[2] for b in blocks)
         if sum(len(v) for v in dst_views) != total:
             raise ValueError("destination size != total remote block length")
+        self._m_reads.inc(len(blocks))
+        self._m_read_bytes.inc(total)
         permits = max(1, len(blocks))
         wrapped = self._wrap_reclaim(listener, permits)
         post = lambda: self._node._post_read(self, wrapped, dst_views, blocks)
@@ -281,6 +298,8 @@ class NativeTpuChannel:
         same-host file-backed blocks arrive as zero-copy page-cache
         mappings; anything else falls back to one streamed copy. The
         listener owns the delivery and must release() it."""
+        self._m_reads.inc(len(blocks))
+        self._m_read_bytes.inc(sum(b[2] for b in blocks))
         permits = max(1, len(blocks))
         wrapped = self._wrap_reclaim(listener, permits)
         post = lambda: self._node._post_read_mapped(self, wrapped, blocks)
@@ -580,7 +599,11 @@ class NativeTpuNode:
             )
             # aux is the raw 32-bit hello word (wire.pack_hello layout)
             peer_port, chan_kind = wire.split_hello_word(c.aux)
-            ch = NativeTpuChannel(self, c.channel, f"{peer_id}:{peer_port}")
+            purpose = "data" if chan_kind == wire.KIND_DATA else "rpc"
+            get_registry().counter("transport.accepts", purpose=purpose).inc()
+            ch = NativeTpuChannel(
+                self, c.channel, f"{peer_id}:{peer_port}", purpose=purpose
+            )
             with self._lock:
                 self._channels[c.channel] = ch
                 stale = self._passive.get((peer_id, chan_kind))
@@ -693,13 +716,19 @@ class NativeTpuNode:
                     kind,
                 )
                 if cid:
+                    get_registry().counter(
+                        "transport.connects", purpose=purpose
+                    ).inc()
                     break
+                get_registry().counter(
+                    "transport.connect_retries", purpose=purpose
+                ).inc()
                 time.sleep(min(0.05 * (2 ** attempt), 1.0))
             if not cid:
                 raise ChannelError(
                     f"could not connect to {host}:{port} after {attempts} attempts"
                 )
-            ch = NativeTpuChannel(self, cid, f"{host}:{port}")
+            ch = NativeTpuChannel(self, cid, f"{host}:{port}", purpose=purpose)
             with self._lock:
                 self._channels[cid] = ch
                 self._active[key] = ch

@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
 from sparkrdma_tpu.memory.registry import ProtectionDomain
+from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.transport import wire
 from sparkrdma_tpu.transport.channel import ChannelError, TpuChannel
 from sparkrdma_tpu.utils.config import TpuShuffleConf
@@ -122,6 +123,8 @@ class TpuNode:
             except OSError:
                 sock.close()
                 continue
+            purpose = "data" if kind == wire.KIND_DATA else "rpc"
+            get_registry().counter("transport.accepts", purpose=purpose).inc()
             channel = TpuChannel(
                 self.conf,
                 self.pd,
@@ -130,6 +133,7 @@ class TpuNode:
                 on_recv=self._recv_listener,
                 on_disconnect=self._on_passive_disconnect,
                 cpu_vector=self._cpu_vectors.next_vector(),
+                purpose=purpose,
             )
             with self._lock:
                 if self._stopped:
@@ -207,9 +211,13 @@ class TpuNode:
             for attempt in range(attempts):
                 try:
                     ch = self._connect(host, port, purpose)
+                    get_registry().counter("transport.connects", purpose=purpose).inc()
                     break
                 except OSError as e:
                     last_err = e
+                    get_registry().counter(
+                        "transport.connect_retries", purpose=purpose
+                    ).inc()
                     time.sleep(min(0.05 * (2**attempt), 1.0))
             if ch is None:
                 raise ChannelError(
@@ -235,6 +243,7 @@ class TpuNode:
             peer_desc=f"{host}:{port}",
             on_recv=self._recv_listener,
             cpu_vector=self._cpu_vectors.next_vector(),
+            purpose=purpose,
         )
         logger.debug(
             "connected to %s:%d in %.1f ms", host, port, (time.monotonic() - start) * 1e3

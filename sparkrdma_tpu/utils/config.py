@@ -173,6 +173,18 @@ class TpuShuffleConf:
     def fetch_time_bucket_size_ms(self) -> int:
         return self._int("fetchTimeBucketSizeInMs", 300, 1, 1 << 30)
 
+    # -- observability (obs/: metrics registry + span tracer) -------------
+    @property
+    def trace_enabled(self) -> bool:
+        """Record spans in the per-role tracers (obs/trace.py). Metrics
+        counters are always on; only span recording is gated."""
+        return self._bool("obs.traceEnabled", True)
+
+    @property
+    def trace_max_spans(self) -> int:
+        """Bound on retained spans per tracer (oldest evicted first)."""
+        return self._int("obs.traceMaxSpans", 20000, 100, 1 << 24)
+
     # -- endpoints / connection management (RdmaShuffleConf.scala:118-126)
     @property
     def driver_host(self) -> str:

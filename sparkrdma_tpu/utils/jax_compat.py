@@ -1,0 +1,30 @@
+"""Version-skew shims for the jax surface the kernels depend on.
+
+``shard_map`` moved between jax releases: new jax exposes it as
+``jax.shard_map`` with a ``check_vma`` kwarg, while the 0.4.x line
+ships it as ``jax.experimental.shard_map.shard_map`` with the
+equivalent kwarg spelled ``check_rep``. Every in-repo kernel imports
+``shard_map`` from here so both families work unmodified.
+"""
+
+from __future__ import annotations
+
+try:  # new jax (>= 0.5): top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _KWARG = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _KWARG = "check_rep"
+
+_ALIASES = ("check_vma", "check_rep")
+
+
+def shard_map(f, *args, **kwargs):
+    """Call the installed shard_map, translating the replication-check
+    kwarg to whichever spelling this jax version accepts."""
+    for alias in _ALIASES:
+        if alias in kwargs and alias != _KWARG:
+            kwargs[_KWARG] = kwargs.pop(alias)
+    return _shard_map(f, *args, **kwargs)

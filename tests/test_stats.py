@@ -1,0 +1,105 @@
+"""RemoteFetchHistogram / ShuffleReaderStats unit tests: bucket
+boundaries, overflow, degenerate-shape guards, concurrency, and
+snapshot/format consistency."""
+
+import threading
+
+from sparkrdma_tpu.locations import ShuffleManagerId
+from sparkrdma_tpu.shuffle.stats import RemoteFetchHistogram, ShuffleReaderStats
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+def test_bucket_boundaries():
+    h = RemoteFetchHistogram(num_buckets=4, bucket_size_ms=10)
+    h.add(0)      # bucket 0
+    h.add(9.99)   # bucket 0
+    h.add(10)     # bucket 1 (floor division)
+    h.add(39.9)   # bucket 3 (last regular)
+    assert h.snapshot() == [2, 1, 0, 1, 0]
+
+
+def test_overflow_boundary():
+    """Latency exactly at num_buckets * bucket_size_ms is the first
+    value past the last regular bucket's range — it must land in the
+    overflow bucket, and anything beyond stays there too."""
+    h = RemoteFetchHistogram(num_buckets=4, bucket_size_ms=10)
+    h.add(40)        # == 4 * 10 → overflow
+    h.add(1_000_000)
+    snap = h.snapshot()
+    assert snap[:-1] == [0, 0, 0, 0]
+    assert snap[-1] == 2
+
+
+def test_negative_latency_clamps_to_first_bucket():
+    """Clock skew can produce a negative latency; floor division would
+    index a negative bucket (i.e. silently count as overflow via
+    Python's negative indexing). It must count in bucket 0 instead."""
+    h = RemoteFetchHistogram(num_buckets=4, bucket_size_ms=10)
+    h.add(-5)
+    h.add(-0.001)
+    snap = h.snapshot()
+    assert snap[0] == 2
+    assert snap[-1] == 0
+
+
+def test_degenerate_shapes_clamped():
+    """bucket_size_ms <= 0 was a ZeroDivisionError in add(); both shape
+    parameters clamp to 1 instead."""
+    h = RemoteFetchHistogram(num_buckets=0, bucket_size_ms=0)
+    h.add(0)
+    h.add(100)
+    assert h.num_buckets == 1
+    assert h.bucket_size_ms == 1
+    assert h.snapshot() == [1, 1]  # one regular bucket + overflow
+
+
+def test_concurrent_add_conserves_count():
+    h = RemoteFetchHistogram(num_buckets=8, bucket_size_ms=5)
+    n_threads, per_thread = 8, 2000
+
+    def work(seed):
+        for i in range(per_thread):
+            h.add((seed * 7 + i) % 60)  # spread across buckets + overflow
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(h.snapshot()) == n_threads * per_thread
+
+
+def test_snapshot_format_consistency():
+    h = RemoteFetchHistogram(num_buckets=3, bucket_size_ms=10)
+    for ms in (1, 11, 12, 25, 99):
+        h.add(ms)
+    snap = h.snapshot()
+    text = h.format()
+    # one bracketed segment per bucket, counts in snapshot order
+    segments = text.split("] ")
+    assert len(segments) == len(snap)
+    for seg, count in zip(segments, snap):
+        assert seg.endswith(f": {count}") or seg.endswith(f": {count}]")
+    # ranges cover [0, 30) then overflow
+    assert "[0-10ms: 1]" in text
+    assert "[10-20ms: 2]" in text
+    assert "[20-30ms: 1]" in text
+    assert "[>30ms: 1]" in text
+
+
+def test_reader_stats_per_remote_and_registry_mirror():
+    conf = TpuShuffleConf()
+    stats = ShuffleReaderStats(conf)
+    a = ShuffleManagerId("127.0.0.1", 1111, "exec-a")
+    b = ShuffleManagerId("127.0.0.1", 2222, "exec-b")
+    stats.update_remote_fetch_histogram(a, 3.0)
+    stats.update_remote_fetch_histogram(a, 7.0)
+    stats.update_remote_fetch_histogram(b, 5.0)
+    snap = stats.snapshot()
+    assert sum(snap["exec-a@127.0.0.1:1111"]) == 2
+    assert sum(snap["exec-b@127.0.0.1:2222"]) == 1
+    from sparkrdma_tpu.obs import get_registry
+
+    reg_snap = get_registry().snapshot(prefix="reader.remote_fetch_ms")
+    key = "reader.remote_fetch_ms{peer=exec-a}"
+    assert reg_snap["histograms"][key]["count"] >= 2
