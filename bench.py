@@ -588,9 +588,30 @@ def bench_device(jax) -> dict:
 
 
 def main() -> None:
+    import argparse
     import os
 
     from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+    from sparkrdma_tpu.testing import faults
+
+    parser = argparse.ArgumentParser(description="sparkrdma_tpu benchmark")
+    parser.add_argument(
+        "--fault-plan",
+        default="",
+        help="fault-injection spec, e.g. 'read:fail:2;rpc:delay:1:delay_ms=50' "
+        "— exercises the resilience ladder under load (docs/RESILIENCE.md)",
+    )
+    parser.add_argument(
+        "--fault-seed",
+        type=int,
+        default=0,
+        help="seed for deterministic fault placement (corrupt byte choice)",
+    )
+    args = parser.parse_args()
+    plan = None
+    if args.fault_plan:
+        plan = faults.FaultPlan.parse(args.fault_plan, seed=args.fault_seed)
+        faults.install(plan)
 
     out = {}
     out.update(bench_native_reads())
@@ -621,6 +642,12 @@ def main() -> None:
         "obs_registry": get_registry().snapshot(),
         "trace_file": trace_path,
     }
+    if plan is not None:
+        record["fault_plan"] = {
+            "spec": args.fault_plan,
+            "seed": args.fault_seed,
+            "injected": plan.total_injected,
+        }
     print(json.dumps(record))
 
 

@@ -179,6 +179,9 @@ class TpuContext:
             except ShuffleError as e:
                 if attempt == 1:
                     raise
+                from sparkrdma_tpu.obs import get_registry
+
+                get_registry().counter("engine.stage_recomputes").inc()
                 logger.warning("fetch failed (%s); recomputing stages", e)
                 # invalidate materialized shuffles below rdd and retry
                 for dep in self._shuffle_deps(rdd):

@@ -28,11 +28,21 @@ _BLOCK = struct.Struct(">QII")  # address(8) length(4) mkey(4)
 
 @dataclass(frozen=True)
 class BlockLocation:
-    """(address, length, mkey) — reference RdmaBlockLocation, :25."""
+    """(address, length, mkey) — reference RdmaBlockLocation, :25.
+
+    ``checksum``/``checksum_algo`` are the resilience layer's integrity
+    tag over the staged bytes (utils/checksum.py), computed at publish
+    time. They are NOT part of the legacy 16-byte serialization below —
+    they travel in the PublishPartitionLocations frame's trailing
+    checksum extension (rpc.py) so legacy parsers
+    (examples/foreign_client.c) keep working. algo 0 = no checksum.
+    """
 
     address: int
     length: int
     mkey: int
+    checksum: int = 0
+    checksum_algo: int = 0
 
     SERIALIZED_SIZE = _BLOCK.size
 

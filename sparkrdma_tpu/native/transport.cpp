@@ -36,6 +36,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <signal.h>
 #include <stdint.h>
 #include <stdio.h>
 #include <string.h>
@@ -1539,6 +1540,11 @@ struct srt_comp_c {
 };
 
 void* srt_node_create(const char* host, uint16_t base_port, int max_retries) {
+  // A peer dying mid-transfer turns the next write() into SIGPIPE,
+  // which would kill the whole process instead of surfacing EPIPE to
+  // the channel's failure path. Ignore it process-wide so broken pipes
+  // degrade to ordinary send errors the retry ladder can handle.
+  signal(SIGPIPE, SIG_IGN);
   Node* n = new Node();
   n->epfd = epoll_create1(0);
   n->evfd = eventfd(0, EFD_NONBLOCK);

@@ -133,18 +133,31 @@ class ExchangeProgram:
         alone is meaningless through an async runtime); callers of the
         host-level entry points consume the results immediately, so
         the sync costs them nothing extra. The valid-byte count reads
-        the int32 length-prefix lane only (tiny), never the payload."""
+        the int32 length-prefix lane only (tiny), never the payload.
+
+        On multi-host meshes ALL byte counters are per-process: capacity
+        comes from this process's addressable shards, not the global
+        array size — ``send.size`` spans every host, and charging the
+        whole global slab to each process would over-report aggregate
+        traffic by ``num_processes ×``."""
+
+        def _cap_bytes(arr) -> int:
+            itemsize = jnp.dtype(arr.dtype).itemsize
+            if getattr(arr, "is_fully_addressable", True):
+                return arr.size * itemsize
+            return sum(s.data.size for s in arr.addressable_shards) * itemsize
+
         recv = jax.block_until_ready(recv)
         rcounts = jax.block_until_ready(rcounts)
         dt = time.perf_counter() - t0
-        cap = send.size * jnp.dtype(send.dtype).itemsize
+        cap = _cap_bytes(send)
         if getattr(rcounts, "is_fully_addressable", True):
             valid = int(np.asarray(rcounts).sum())
         else:  # multi-host: only this process's shards are readable
             valid = int(
                 sum(np.asarray(s.data).sum() for s in rcounts.addressable_shards)
             )
-        recv_cap = recv.size * jnp.dtype(recv.dtype).itemsize
+        recv_cap = _cap_bytes(recv)
         s = self.stats[label]
         s["exchanges"] += 1
         s["bytes_sent"] += cap

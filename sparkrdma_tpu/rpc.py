@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import enum
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from io import BytesIO
 from typing import List, Optional, Sequence
 
@@ -112,15 +112,37 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # is_last(1) shuffle_id(4) partition_id(4) num_map_outputs(4)
     _HDR = struct.Struct(">Biii")
     _TRACE_EXT = struct.Struct(">Q")
+    # per-segment checksum extension (resilience layer): written AFTER
+    # the locations, BEFORE the trace extension. The marker 0xFFFF is
+    # impossible as a ShuffleManagerId host length (a 64 KiB hostname
+    # cannot fit a 4 KiB segment), so a parser peeking two bytes
+    # distinguishes "next location" from "checksum extension"
+    # unambiguously; examples/foreign_client.c's bounds check
+    # (``o + hl + 4 + 2 > n``) makes the marker terminate its parse
+    # loop safely. Layout: marker(2) count(4), then per location
+    # algo(1) crc(4) — algo-tagged so mixed publishers coexist
+    # (utils/checksum.py).
+    _CK_MARKER = 0xFFFF
+    _CK_HDR = struct.Struct(">HI")
+    _CK_ITEM = struct.Struct(">BI")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
-        budget = seg_size - SEG_HEADER.size - self._HDR.size - self._TRACE_EXT.size
+        has_ck = any(loc.block.checksum_algo for loc in self.locations)
+        ck_fixed = self._CK_HDR.size if has_ck else 0
+        ck_per_loc = self._CK_ITEM.size if has_ck else 0
+        budget = (
+            seg_size
+            - SEG_HEADER.size
+            - self._HDR.size
+            - self._TRACE_EXT.size
+            - ck_fixed
+        )
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
         groups: List[List[PartitionLocation]] = [[]]
         used = 0
         for loc in self.locations:
-            sz = loc.serialized_size()
+            sz = loc.serialized_size() + ck_per_loc
             if sz > budget:
                 raise ValueError(
                     f"partition location ({sz} bytes) exceeds segment budget {budget}"
@@ -144,6 +166,15 @@ class PublishPartitionLocationsMsg(RpcMsg):
             )
             for loc in group:
                 loc.write(buf)
+            if has_ck and group:
+                buf.write(self._CK_HDR.pack(self._CK_MARKER, len(group)))
+                for loc in group:
+                    buf.write(
+                        self._CK_ITEM.pack(
+                            loc.block.checksum_algo & 0xFF,
+                            loc.block.checksum & 0xFFFFFFFF,
+                        )
+                    )
             buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
@@ -157,8 +188,34 @@ class PublishPartitionLocationsMsg(RpcMsg):
         locs = []
         end = len(payload)
         # locations are each >= 28 bytes, so a residue of exactly 8 is
-        # the trailing trace-id extension (absent from legacy senders)
+        # the trailing trace-id extension (absent from legacy senders);
+        # a 0xFFFF two-byte peek is the checksum extension, which is
+        # always the last element before the trace id
         while end - inp.tell() > cls._TRACE_EXT.size:
+            pos = inp.tell()
+            peek = inp.read(cls._CK_HDR.size)
+            if len(peek) == cls._CK_HDR.size:
+                marker, count = cls._CK_HDR.unpack(peek)
+                if marker == cls._CK_MARKER:
+                    if count == len(locs):
+                        for i in range(count):
+                            algo, crc = cls._CK_ITEM.unpack(
+                                inp.read(cls._CK_ITEM.size)
+                            )
+                            if algo:
+                                locs[i] = replace(
+                                    locs[i],
+                                    block=replace(
+                                        locs[i].block,
+                                        checksum=crc,
+                                        checksum_algo=algo,
+                                    ),
+                                )
+                    else:
+                        # count mismatch (corrupt/foreign ext): skip it
+                        inp.read(count * cls._CK_ITEM.size)
+                    break
+            inp.seek(pos)
             locs.append(PartitionLocation.read(inp))
         trace_id = 0
         if end - inp.tell() == cls._TRACE_EXT.size:

@@ -32,6 +32,7 @@ from sparkrdma_tpu.ops.hbm_arena import (
 )
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
 from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
+from sparkrdma_tpu.utils import checksum as _checksum
 
 logger = logging.getLogger(__name__)
 
@@ -135,7 +136,13 @@ class DeviceShuffleIO:
         reference's future-timeout wrapper semantics,
         RdmaShuffleFetcherIterator.scala:108-122) — not a per-block
         allowance, so one slow peer costs at most one timeout, never
-        ``n_blocks ×``. Arrived buffers stage in COMPLETION order while
+        ``n_blocks ×``. The clock starts BEFORE the metadata RPC: the
+        location fetch and the data reads share the same wall budget,
+        so the worst case is 1× ``timeout_s``, not metadata-timeout +
+        data-timeout. Fetched blocks are validated against their
+        published checksum before staging; a mismatch earns one
+        same-source refetch, then FetchFailedError.
+        Arrived buffers stage in COMPLETION order while
         slower reads are still in flight: staging (the expensive
         host->HBM transfer on this rig) overlaps the waiting instead of
         serializing behind issue order."""
@@ -145,12 +152,18 @@ class DeviceShuffleIO:
             timeout_s = conf.fetch_location_timeout_ms / 1000.0
         t_transport = t_stage = 0.0
         n_bytes = 0
+        # the deadline covers metadata + data: started before the
+        # location RPC, and the data-wait loop below runs on whatever
+        # budget that RPC left over
+        deadline = time.monotonic() + timeout_s
         future = mgr.fetch_remote_partition_locations(
             shuffle_id, start_partition, end_partition
         )
         tw = time.perf_counter()
         try:
-            locations: List[PartitionLocation] = future.result(timeout=timeout_s)
+            locations: List[PartitionLocation] = future.result(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
         except Exception as e:
             raise MetadataFetchFailedError(shuffle_id, start_partition, str(e))
         finally:
@@ -293,8 +306,8 @@ class DeviceShuffleIO:
                     reg = mgr.buffer_manager.get(loc.block.length)
                     pending.append(start_read(len(pending), loc, reg, ch))
 
-            deadline = time.monotonic() + timeout_s
             remaining = {i for i, e in enumerate(pending) if e is not None}
+            refetched: set = set()
             while remaining:
                 budget = deadline - time.monotonic()
                 tw = time.perf_counter()
@@ -325,11 +338,50 @@ class DeviceShuffleIO:
                 if idx not in remaining:
                     continue  # duplicate completion post
                 loc, obj, done, errbox, _abandon = pending[idx]
+                if not done.is_set():
+                    # stale post from a superseded (refetched) attempt;
+                    # the live read posts idx again on completion
+                    continue
                 if errbox:
+                    mgr.health.record_failure(loc.manager_id.executor_id)
                     raise FetchFailedError(
                         loc.manager_id, shuffle_id, -1, loc.partition_id,
                         str(errbox[0]),
                     )
+                # integrity gate before the expensive host->HBM stage
+                if isinstance(obj, dict):
+                    d = obj["d"]
+                    ck_view = d.views[0] if d.views else b""
+                else:
+                    ck_view = obj.view[: loc.block.length]
+                if not _checksum.verify(
+                    ck_view, loc.block.checksum, loc.block.checksum_algo
+                ):
+                    if isinstance(obj, dict):
+                        obj["d"].release()
+                    else:
+                        mgr.buffer_manager.put(obj)
+                    get_registry().counter(
+                        "resilience.checksum_failures", role=my_id
+                    ).inc()
+                    if idx in refetched:
+                        mgr.health.record_failure(loc.manager_id.executor_id)
+                        raise FetchFailedError(
+                            loc.manager_id, shuffle_id, -1, loc.partition_id,
+                            "checksum mismatch persisted across refetch",
+                        )
+                    refetched.add(idx)
+                    get_registry().counter(
+                        "resilience.retries", role=my_id
+                    ).inc()
+                    ch = mgr.get_channel_to(loc.manager_id, purpose="data")
+                    if isinstance(obj, dict):
+                        pending[idx] = start_read_mapped(idx, loc, ch)
+                    else:
+                        reg2 = mgr.buffer_manager.get(loc.block.length)
+                        pending[idx] = start_read(idx, loc, reg2, ch)
+                    continue
+                mgr.health.record_success(loc.manager_id.executor_id)
                 ts = time.perf_counter()
                 if isinstance(obj, dict):
                     # mapped delivery: stage straight from the page-cache

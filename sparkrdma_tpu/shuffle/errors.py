@@ -43,3 +43,19 @@ class MetadataFetchFailedError(ShuffleError):
         super().__init__(
             f"metadata fetch failed: shuffle {shuffle_id} partition {partition_id}: {message}"
         )
+
+
+class ChecksumError(IOError):
+    """A fetched block's bytes do not match the published checksum.
+
+    Deliberately an IOError, not a ShuffleError: inside the fetcher it
+    is a *retryable* transport-grade fault (the retry ladder re-reads
+    the block); only retry exhaustion promotes it into the
+    FetchFailedError that triggers stage recompute."""
+
+    def __init__(self, shuffle_id: int, partition_id: int, message: str):
+        self.shuffle_id = shuffle_id
+        self.partition_id = partition_id
+        super().__init__(
+            f"checksum mismatch: shuffle {shuffle_id} partition {partition_id}: {message}"
+        )

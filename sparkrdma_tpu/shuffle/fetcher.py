@@ -18,9 +18,15 @@ RdmaShuffleFetcherIterator.scala). Semantics preserved:
 - the blocking results queue carries Success/Failure/FailureMetadata
   and a sentinel "+1 block" protocol keeps ``has_next`` truthful until
   all fetches are enqueued (:47-50, 124-130, 288, 434-448),
-- failures surface as FetchFailedError / MetadataFetchFailedError so
-  the scheduler can recompute; one failed block fails the whole reduce
-  task by design (:203, 381-391),
+- failures walk the resilience retry ladder BEFORE surfacing
+  (docs/RESILIENCE.md): retry the same source with backoff, re-resolve
+  locations from the driver (stale mkeys / respawned writers), split
+  the aggregated group into per-block fetches — and only after
+  exhaustion (or an open circuit breaker, or a blown deadline) raise
+  FetchFailedError / MetadataFetchFailedError for stage recompute
+  (:203, 381-391 — the reference's ONLY move, now the last resort),
+- delivered blocks are validated against their published checksum; a
+  mismatch is a retryable fault like any other READ failure,
 - streams release their registered buffer slice on close
   (BufferReleasingInputStream, :399-429),
 - per-fetch latency histogram hook (:186-189).
@@ -40,8 +46,14 @@ from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.obs import now as obs_now
-from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
+from sparkrdma_tpu.resilience import CircuitOpenError, RetryPolicy
+from sparkrdma_tpu.shuffle.errors import (
+    ChecksumError,
+    FetchFailedError,
+    MetadataFetchFailedError,
+)
 from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
+from sparkrdma_tpu.utils import checksum as _checksum
 
 logger = logging.getLogger(__name__)
 
@@ -87,8 +99,17 @@ class _Dummy:
 
 @dataclass
 class _PendingFetch:
+    """One group READ plus its position on the retry ladder.
+
+    ``attempt`` is the next attempt number to issue (0 = initial);
+    ``deadline`` is the group's wall budget across ALL its retries
+    (monotonic seconds; +inf when resilience.fetchDeadlineMs is 0).
+    """
+
     manager_id: ShuffleManagerId
     group: AggregatedPartitionGroup
+    attempt: int = 0
+    deadline: float = float("inf")
 
 
 class TpuShuffleFetcherIterator:
@@ -110,6 +131,18 @@ class TpuShuffleFetcherIterator:
         self._m_remote_bytes = reg.counter("reader.remote_bytes", role=role)
         self._m_fetch_wait_ms = reg.counter("reader.fetch_wait_ms", role=role)
         self._h_fetch_ms = reg.histogram("reader.fetch_ms", role=role)
+
+        # resilience: retry policy, per-peer circuit breakers (shared
+        # with the manager), and the resilience.* counter family
+        self._retry_policy = RetryPolicy.from_conf(manager.conf)
+        self._health = manager.health
+        self._m_retries = reg.counter("resilience.retries", role=role)
+        self._m_checksum_failures = reg.counter(
+            "resilience.checksum_failures", role=role
+        )
+        self._m_failovers = reg.counter("resilience.failovers", role=role)
+        self._m_splits = reg.counter("resilience.splits", role=role)
+        self._m_fail_fast = reg.counter("resilience.circuit_fail_fast", role=role)
 
         self._results: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
@@ -203,17 +236,18 @@ class TpuShuffleFetcherIterator:
 
         # pack per-manager groups ≤ read_block_size (:252-275)
         read_block_size = self._manager.conf.shuffle_read_block_size
+        deadline = time.monotonic() + self._retry_policy.deadline_s()
         fetches: List[_PendingFetch] = []
         for mid, blocks in by_manager.items():
             group = AggregatedPartitionGroup()
             for pid, block in blocks:
                 if group.blocks and group.total_length + block.length > read_block_size:
-                    fetches.append(_PendingFetch(mid, group))
+                    fetches.append(_PendingFetch(mid, group, deadline=deadline))
                     group = AggregatedPartitionGroup()
                 group.blocks.append((pid, block))
                 group.total_length += block.length
             if group.blocks:
-                fetches.append(_PendingFetch(mid, group))
+                fetches.append(_PendingFetch(mid, group, deadline=deadline))
 
         max_in_flight = self._manager.conf.max_bytes_in_flight
         start_now: List[_PendingFetch] = []
@@ -234,10 +268,11 @@ class TpuShuffleFetcherIterator:
         for fetch in start_now:
             self._fetch_blocks(fetch)
 
-    def _group_failure(self, mid, group, cleanup=None):
-        """Once-only failure handler for one group READ (on_failure may
-        legally fire more than once; ``cleanup`` releases the group's
-        destination resources, if any, before the error is queued)."""
+    def _group_failure(self, fetch: _PendingFetch, cleanup=None):
+        """Once-only failure handler for one group READ attempt
+        (on_failure may legally fire more than once; ``cleanup``
+        releases the attempt's destination resources, if any). The
+        failure enters the retry ladder instead of surfacing directly."""
         failed_once = threading.Event()
 
         def on_failure(e: Exception) -> None:
@@ -246,11 +281,168 @@ class TpuShuffleFetcherIterator:
             failed_once.set()
             if cleanup is not None:
                 cleanup()
-            self._results.put(
-                _Failure(mid, group.blocks[0][0], e, in_flight=group.total_length)
-            )
+            self._retry_or_fail(fetch, e)
 
         return on_failure
+
+    # ------------------------------------------------------------------
+    # resilience: the retry ladder (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def _surface_failure(self, fetch: _PendingFetch, error: Exception) -> None:
+        self._results.put(
+            _Failure(
+                fetch.manager_id,
+                fetch.group.blocks[0][0],
+                error,
+                in_flight=fetch.group.total_length,
+            )
+        )
+
+    def _retry_or_fail(self, fetch: _PendingFetch, error: Exception) -> None:
+        """One attempt failed: schedule the next ladder rung, or give up.
+
+        Gives up — surfacing _Failure for FetchFailedError / stage
+        recompute — when the policy's attempts are exhausted, the
+        group's wall deadline has passed, the error is non-retryable
+        (an open circuit IS the fail-fast decision), or the iterator
+        closed. Otherwise the retry is scheduled on a timer after the
+        policy's deterministic backoff; no completion thread sleeps.
+        """
+        mid, group = fetch.manager_id, fetch.group
+        failed_attempt = fetch.attempt
+        retryable = not isinstance(error, CircuitOpenError)
+        if retryable:
+            self._health.record_failure(mid.executor_id)
+        with self._lock:
+            closed = self._closed
+        if (
+            not retryable
+            or closed
+            or not self._retry_policy.allows(failed_attempt + 1)
+            or time.monotonic() >= fetch.deadline
+        ):
+            self._surface_failure(fetch, error)
+            return
+        fetch.attempt = failed_attempt + 1
+        self._m_retries.inc()
+        delay = self._retry_policy.backoff_s(
+            failed_attempt,
+            self._handle.shuffle_id,
+            mid.executor_id,
+            group.blocks[0][0],
+        )
+        logger.info(
+            "fetch group from %s failed (attempt %d: %s); retrying in %.0f ms",
+            mid.executor_id,
+            failed_attempt,
+            error,
+            delay * 1e3,
+        )
+        t = threading.Timer(delay, self._retry_fetch, args=(fetch,))
+        t.daemon = True
+        t.start()
+
+    def _retry_fetch(self, fetch: _PendingFetch) -> None:
+        """Issue the next rung: 1 = same source, 2 = re-resolve and
+        failover, 3+ = split the group into per-block fetches."""
+        with self._lock:
+            if self._closed:
+                return  # dead task; the attempt holds no resources
+        if fetch.attempt >= 3 and len(fetch.group.blocks) > 1:
+            self._split_and_refetch(fetch)
+        elif fetch.attempt >= 2:
+            self._failover_refetch(fetch)
+        else:
+            self._fetch_blocks(fetch)
+
+    def _failover_refetch(self, fetch: _PendingFetch) -> None:
+        """Re-resolve locations from the driver and re-aim the group.
+
+        Handles stale mkeys and respawned writers: a re-published block
+        of the same (partition, length) on the same executor identity
+        replaces the stale handle, and the fresh ShuffleManagerId
+        carries the respawned endpoint's host:port. Blocks never
+        migrate across executor identities without a stage recompute,
+        so matching stays within ``mid.executor_id`` — a cross-manager
+        "match" would be a different map output's data. Runs on a retry
+        timer thread, so blocking on the location future is fine."""
+        mid, group = fetch.manager_id, fetch.group
+        try:
+            future = self._manager.fetch_remote_partition_locations(
+                self._handle.shuffle_id, self.start_partition, self.end_partition
+            )
+            fresh: List[PartitionLocation] = future.result(
+                timeout=self._manager.conf.fetch_location_timeout_ms / 1000.0
+            )
+        except Exception as e:
+            logger.warning(
+                "failover re-resolve failed (%s); retrying stale locations", e
+            )
+            self._fetch_blocks(fetch)
+            return
+        self._m_failovers.inc()
+        pool: Dict[Tuple[int, int], List[PartitionLocation]] = {}
+        for loc in fresh:
+            if loc.manager_id.executor_id != mid.executor_id:
+                continue
+            pool.setdefault((loc.partition_id, loc.block.length), []).append(loc)
+        new_mid = mid
+        new_blocks: List[Tuple[int, BlockLocation]] = []
+        for pid, block in group.blocks:
+            cands = pool.get((pid, block.length), [])
+            # prefer the exact published handle (unchanged block); else
+            # any re-published sibling of the same length
+            pick = next((l for l in cands if l.block == block), None)
+            if pick is None and cands:
+                pick = cands[0]
+            if pick is not None:
+                cands.remove(pick)
+                block = pick.block
+                new_mid = pick.manager_id
+            new_blocks.append((pid, block))
+        fetch.manager_id = new_mid
+        fetch.group = AggregatedPartitionGroup(
+            total_length=group.total_length, blocks=new_blocks
+        )
+        self._fetch_blocks(fetch)
+
+    def _split_and_refetch(self, fetch: _PendingFetch) -> None:
+        """Break the aggregated group into single-block fetches so one
+        poisoned block no longer fails its groupmates. Each sub-fetch
+        keeps the parent's attempt number and deadline; the result
+        accounting grows by k-1 (each sub-result carries its own
+        in_flight share, summing to the parent's)."""
+        mid, group = fetch.manager_id, fetch.group
+        subs = [
+            _PendingFetch(
+                mid,
+                AggregatedPartitionGroup(
+                    total_length=block.length, blocks=[(pid, block)]
+                ),
+                attempt=fetch.attempt,
+                deadline=fetch.deadline,
+            )
+            for pid, block in group.blocks
+        ]
+        with self._lock:
+            if self._closed:
+                return
+            self._total_results += len(subs) - 1
+        self._m_splits.inc()
+        logger.info(
+            "splitting %d-block group from %s for per-block retry",
+            len(subs),
+            mid.executor_id,
+        )
+        for sub in subs:
+            self._fetch_blocks(sub)
+
+    def _bad_block(self, group: AggregatedPartitionGroup, views) -> Optional[int]:
+        """Index of the first checksum-mismatched block, else None."""
+        for i, ((_pid, block), view) in enumerate(zip(group.blocks, views)):
+            if not _checksum.verify(view, block.checksum, block.checksum_algo):
+                return i
+        return None
 
     def _deliver_group(self, mid, group, streams, t0) -> None:
         """Shared success epilogue: histogram, metrics, closed-aware
@@ -279,8 +471,20 @@ class TpuShuffleFetcherIterator:
         self._put_success(streams, group.total_length)
 
     def _fetch_blocks(self, fetch: _PendingFetch) -> None:
-        """Issue one one-sided READ for a whole group (:132-218)."""
+        """Issue one one-sided READ attempt for a group (:132-218)."""
         mid, group = fetch.manager_id, fetch.group
+        if not self._health.allow(mid.executor_id):
+            # open circuit: no READ, no retry ladder — the breaker IS
+            # the fail-fast decision for a peer presumed dead, so this
+            # surfaces immediately as a FetchFailedError / recompute
+            self._m_fail_fast.inc()
+            self._surface_failure(
+                fetch,
+                CircuitOpenError(
+                    f"circuit to {mid.executor_id} is open (peer unhealthy)"
+                ),
+            )
+            return
         t0 = obs_now()
         try:
             # bulk READ payloads ride the data-flavor channel so an 8 MiB
@@ -295,12 +499,30 @@ class TpuShuffleFetcherIterator:
             # when the last stream closes (:399-429)
             slices = [reg.slice(block.length) for _, block in group.blocks]
         except Exception as e:
-            self._results.put(
-                _Failure(mid, group.blocks[0][0], e, in_flight=group.total_length)
-            )
+            # connect/allocation failures walk the same ladder as READ
+            # completions: a refused connection to a restarting peer is
+            # exactly what same-source retry + failover exist for
+            self._retry_or_fail(fetch, e)
             return
 
+        fail = self._group_failure(
+            fetch, cleanup=lambda: [sl.release() for sl in slices]
+        )
+
         def on_success(_) -> None:
+            bad = self._bad_block(group, [sl.view for sl in slices])
+            if bad is not None:
+                pid, block = group.blocks[bad]
+                self._m_checksum_failures.inc()
+                fail(
+                    ChecksumError(
+                        self._handle.shuffle_id,
+                        pid,
+                        f"block of {block.length} bytes from {mid.executor_id}",
+                    )
+                )
+                return
+            self._health.record_success(mid.executor_id)
             streams: List[Tuple[int, BinaryIO]] = [
                 (pid, MemoryviewInputStream(sl.view, on_close=sl.release))
                 for (pid, _block), sl in zip(group.blocks, slices)
@@ -308,13 +530,7 @@ class TpuShuffleFetcherIterator:
             self._deliver_group(mid, group, streams, t0)
 
         channel.read_in_queue(
-            FnListener(
-                on_success,
-                self._group_failure(
-                    mid, group,
-                    cleanup=lambda: [sl.release() for sl in slices],
-                ),
-            ),
+            FnListener(on_success, fail),
             [sl.view for sl in slices],
             [(block.mkey, block.address, block.length) for _, block in group.blocks],
         )
@@ -327,8 +543,23 @@ class TpuShuffleFetcherIterator:
         closes, exactly like the registered buffer's refcounted
         slices (:399-429)."""
         mid, group = fetch.manager_id, fetch.group
+        fail = self._group_failure(fetch)
 
         def on_success(delivery) -> None:
+            bad = self._bad_block(group, delivery.views)
+            if bad is not None:
+                pid, block = group.blocks[bad]
+                self._m_checksum_failures.inc()
+                delivery.release()
+                fail(
+                    ChecksumError(
+                        self._handle.shuffle_id,
+                        pid,
+                        f"block of {block.length} bytes from {mid.executor_id}",
+                    )
+                )
+                return
+            self._health.record_success(mid.executor_id)
             remaining = [len(delivery.views)]
             lock = threading.Lock()
 
@@ -346,7 +577,7 @@ class TpuShuffleFetcherIterator:
             self._deliver_group(mid, group, streams, t0)
 
         channel.read_mapped_in_queue(
-            FnListener(on_success, self._group_failure(mid, group)),
+            FnListener(on_success, fail),
             [(block.mkey, block.address, block.length)
              for _, block in group.blocks],
         )

@@ -25,11 +25,15 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
+from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
+from sparkrdma_tpu.resilience import SourceHealthRegistry
+from sparkrdma_tpu.testing import faults as _faults
+from sparkrdma_tpu.utils import checksum as _checksum
 from sparkrdma_tpu.rpc import (
     AnnounceManagersMsg,
     FetchPartitionLocationsMsg,
@@ -106,6 +110,12 @@ class TpuShuffleManager:
         )
         self._reader_metrics: List[object] = []
 
+        # resilience: per-remote-manager circuit breakers (fetchers and
+        # the device IO path consult these before issuing READs) and
+        # the conf-driven fault plan for reproducible chaos runs
+        self.health = SourceHealthRegistry(conf, role=self.executor_id)
+        _faults.ensure_installed(conf.fault_plan, conf.fault_plan_seed)
+
         if is_driver:
             # driver starts its node eagerly and records the negotiated
             # port for executors (:180-184)
@@ -163,6 +173,13 @@ class TpuShuffleManager:
     # ------------------------------------------------------------------
     def _receive_listener(self, channel, payload: bytes) -> None:
         t0 = time.perf_counter()
+        plan = _faults.active()
+        if plan is not None:
+            payload, handled = plan.on_rpc(
+                getattr(channel, "peer_desc", ""), payload
+            )
+            if handled:
+                return
         try:
             msg = RpcMsg.parse_segment(payload)
             if isinstance(msg, ManagerHelloMsg):
@@ -350,6 +367,31 @@ class TpuShuffleManager:
     # ------------------------------------------------------------------
     # metadata API (reference :343-420)
     # ------------------------------------------------------------------
+    def _with_checksum(self, loc: PartitionLocation) -> PartitionLocation:
+        """Attach the publish-time integrity tag to one location.
+
+        Computed HERE — the single funnel every publish path (wrapper
+        writer, chunked-agg finalize, device IO, manual test publishes)
+        already flows through — by resolving the advertised
+        ``(mkey, address, length)`` in the local ProtectionDomain,
+        exactly the view a remote READ will be served from. Resolution
+        failure (foreign publisher, unregistered test triple) leaves
+        the location untagged: integrity is best-effort, never a new
+        failure mode."""
+        if loc.block.checksum_algo or loc.block.length == 0:
+            return loc
+        node = self.node
+        if node is None:
+            return loc
+        try:
+            view = node.pd.resolve(loc.block.mkey, loc.block.address, loc.block.length)
+        except Exception:
+            return loc
+        algo, crc = _checksum.compute(view)
+        if algo == _checksum.ALGO_NONE:
+            return loc
+        return replace(loc, block=replace(loc.block, checksum=crc, checksum_algo=algo))
+
     def publish_partition_locations(
         self,
         shuffle_id: int,
@@ -357,6 +399,8 @@ class TpuShuffleManager:
         locations: List[PartitionLocation],
         num_map_outputs: int = 0,
     ) -> None:
+        if self.conf.resilience_checksums:
+            locations = [self._with_checksum(loc) for loc in locations]
         msg = PublishPartitionLocationsMsg(
             shuffle_id,
             partition_id,
@@ -544,6 +588,8 @@ class TpuShuffleManager:
             for k in agg:
                 agg[k] += getattr(m, k, 0)
         snap["shuffle_read"] = agg
+        # circuit-breaker states per tracked remote peer (resilience)
+        snap["source_health"] = self.health.states()
         # the unified registry view: every instrument whose labels are
         # compatible with this manager's role (process-global metrics
         # without a role label are included)

@@ -1,0 +1,11 @@
+"""Test/bench support subsystems shipped with the framework.
+
+:mod:`faults` — the seeded, config-driven fault-injection plan hooked
+at the transport and RPC seams (docs/RESILIENCE.md). Importing this
+package costs nothing at runtime: the hot-path check is a single
+module-level ``active()`` None test.
+"""
+
+from sparkrdma_tpu.testing import faults
+
+__all__ = ["faults"]

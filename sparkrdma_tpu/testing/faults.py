@@ -1,0 +1,301 @@
+"""Fault injection as a first-class subsystem (docs/RESILIENCE.md).
+
+Promotes the ad-hoc monkeypatching the fault tests started with into a
+seeded, config-driven *fault plan* hooked at three seams:
+
+  - ``read``  — one-sided READ verbs (``TpuChannel.read_in_queue``,
+    ``NativeTpuChannel.read_in_queue`` / ``read_mapped_in_queue``)
+  - ``send``  — two-sided SEND verbs (RPC segment posts)
+  - ``rpc``   — message dispatch (``TpuShuffleManager._receive_listener``)
+
+Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
+``delay`` (sleep ``delay_ms`` then proceed), ``corrupt`` (flip one
+deterministic byte of the delivered payload — the checksum layer's
+adversary), ``drop`` (connection drop for verbs; silent message loss
+for sends/rpc).
+
+Plans are spec strings — ``op:kind:count[:k=v[,k=v...]]`` joined with
+``;`` — so they travel through conf keys (``tpu.shuffle.faultPlan`` +
+``faultPlanSeed``), pytest parametrization, and ``bench.py
+--fault-plan`` identically. ``count`` 0 means unlimited. Options:
+``after=N`` (skip the first N matching ops), ``delay_ms=N``,
+``peer=SUBSTR`` (match on the channel's peer description).
+
+The plan installs process-globally (:func:`install` /
+:func:`uninstall` / the :func:`installed` context manager); the hot
+path pays one module-attribute None check when no plan is active.
+Everything a plan does is deterministic given (spec, seed).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+OPS = ("read", "send", "rpc")
+KINDS = ("fail", "delay", "corrupt", "drop")
+
+
+class InjectedFault(IOError):
+    """The error surfaced by ``fail``/``drop`` rules."""
+
+
+@dataclass
+class FaultRule:
+    """One rule of a plan; see module docstring for the spec grammar."""
+
+    op: str
+    kind: str
+    count: int = 1  # 0 = unlimited
+    after: int = 0
+    delay_ms: int = 0
+    peer: str = ""
+
+    def __post_init__(self):
+        if self.op not in OPS:
+            raise ValueError(f"unknown fault op {self.op!r}; expected one of {OPS}")
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; expected one of {KINDS}"
+            )
+
+    @classmethod
+    def parse(cls, item: str) -> "FaultRule":
+        parts = item.strip().split(":")
+        if len(parts) < 2:
+            raise ValueError(f"fault rule {item!r}: expected op:kind[:count[:opts]]")
+        op, kind = parts[0].strip().lower(), parts[1].strip().lower()
+        count = int(parts[2]) if len(parts) > 2 and parts[2].strip() else 1
+        opts: Dict[str, str] = {}
+        if len(parts) > 3 and parts[3].strip():
+            for kv in parts[3].split(","):
+                k, _, v = kv.partition("=")
+                opts[k.strip()] = v.strip()
+        return cls(
+            op=op,
+            kind=kind,
+            count=count,
+            after=int(opts.pop("after", 0)),
+            delay_ms=int(opts.pop("delay_ms", 0)),
+            peer=opts.pop("peer", ""),
+        )
+
+
+class FaultPlan:
+    """A seeded set of rules plus its firing bookkeeping. Thread-safe."""
+
+    def __init__(self, rules: Sequence[FaultRule], seed: int = 0, spec: str = ""):
+        self.rules: List[FaultRule] = list(rules)
+        self.seed = seed
+        self.spec = spec or ";".join(
+            f"{r.op}:{r.kind}:{r.count}" for r in self.rules
+        )
+        self._lock = threading.Lock()
+        # per-rule: how many matching ops were seen / faults fired
+        self._seen = [0] * len(self.rules)
+        self._fired = [0] * len(self.rules)
+        self.injected: Dict[Tuple[str, str], int] = {}
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        rules = [
+            FaultRule.parse(item)
+            for item in spec.split(";")
+            if item.strip()
+        ]
+        return cls(rules, seed=seed, spec=spec)
+
+    # -- bookkeeping ----------------------------------------------------
+    @property
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+    def injected_count(self, op: str = None, kind: str = None) -> int:
+        with self._lock:
+            return sum(
+                n
+                for (o, k), n in self.injected.items()
+                if (op is None or o == op) and (kind is None or k == kind)
+            )
+
+    def _match(self, op: str, peer: str) -> Optional[Tuple[FaultRule, int]]:
+        """First applicable rule for this op, or None. Decrements its
+        budget and returns (rule, global fire index) when it fires."""
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.op != op:
+                    continue
+                if rule.peer and rule.peer not in peer:
+                    continue
+                self._seen[i] += 1
+                if self._seen[i] <= rule.after:
+                    continue
+                if rule.count and self._fired[i] >= rule.count:
+                    continue
+                self._fired[i] += 1
+                key = (rule.op, rule.kind)
+                self.injected[key] = self.injected.get(key, 0) + 1
+                fire_index = sum(self.injected.values())
+                return rule, fire_index
+            return None
+
+    def _flip_byte(self, view, fire_index: int) -> None:
+        """Deterministically corrupt one byte of a writable buffer."""
+        if len(view) == 0:
+            return
+        rng = random.Random((self.seed << 20) ^ fire_index)
+        idx = rng.randrange(len(view))
+        view[idx] ^= 0xFF
+
+    # -- seam entry points ---------------------------------------------
+    def on_read(
+        self, channel, listener, dst_views, blocks
+    ) -> Tuple[object, bool]:
+        """READ-verb seam. Returns (listener, handled); handled=True
+        means the fault consumed the verb and the caller must return."""
+        hit = self._match("read", getattr(channel, "peer_desc", ""))
+        if hit is None:
+            return listener, False
+        rule, fire_index = hit
+        logger.info("fault plan: %s read on %s", rule.kind, channel.peer_desc)
+        if rule.kind == "fail":
+            listener.on_failure(InjectedFault("injected read fault"))
+            return listener, True
+        if rule.kind == "drop":
+            _drop_channel(channel)
+            listener.on_failure(InjectedFault("injected connection drop"))
+            return listener, True
+        if rule.kind == "delay":
+            time.sleep(rule.delay_ms / 1000.0)
+            return listener, False
+        # corrupt: let the READ complete, then flip one byte of the
+        # landed payload before the fetcher sees it (checksum adversary)
+        if dst_views is None:
+            # mapped delivery exposes read-only page-cache windows; the
+            # closest honest corruption is a failed delivery
+            listener.on_failure(InjectedFault("injected read fault (mapped)"))
+            return listener, True
+        inner = listener
+        views = list(dst_views)
+
+        class _Corrupting:
+            def on_success(self_inner, payload):
+                for v in views:
+                    if len(v):
+                        self._flip_byte(v, fire_index)
+                        break
+                inner.on_success(payload)
+
+            def on_failure(self_inner, e):
+                inner.on_failure(e)
+
+        return _Corrupting(), False
+
+    def on_send(self, channel, listener, segments) -> Tuple[object, bool]:
+        """SEND-verb seam. Same contract as :meth:`on_read`."""
+        hit = self._match("send", getattr(channel, "peer_desc", ""))
+        if hit is None:
+            return listener, False
+        rule, _ = hit
+        logger.info("fault plan: %s send on %s", rule.kind, channel.peer_desc)
+        if rule.kind in ("fail", "corrupt"):
+            listener.on_failure(InjectedFault("injected send fault"))
+            return listener, True
+        if rule.kind == "drop":
+            # the message is silently lost: success to the sender, the
+            # receiver never sees it (lost-datagram semantics)
+            listener.on_success(None)
+            return listener, True
+        time.sleep(rule.delay_ms / 1000.0)
+        return listener, False
+
+    def on_rpc(self, peer: str, payload: bytes) -> Tuple[bytes, bool]:
+        """RPC-dispatch seam. Returns (payload, handled); handled=True
+        discards the message."""
+        hit = self._match("rpc", peer)
+        if hit is None:
+            return payload, False
+        rule, fire_index = hit
+        logger.info("fault plan: %s rpc from %s", rule.kind, peer)
+        if rule.kind in ("fail", "drop"):
+            return payload, True
+        if rule.kind == "delay":
+            time.sleep(rule.delay_ms / 1000.0)
+            return payload, False
+        mutated = bytearray(payload)
+        self._flip_byte(mutated, fire_index)
+        return bytes(mutated), False
+
+
+def _drop_channel(channel) -> None:
+    try:
+        channel.stop()
+    except Exception:
+        logger.exception("fault plan: dropping channel failed")
+
+
+# ----------------------------------------------------------------------
+# process-global installation
+# ----------------------------------------------------------------------
+_active: Optional[FaultPlan] = None
+_install_lock = threading.Lock()
+
+
+def active() -> Optional[FaultPlan]:
+    """The installed plan, or None — THE hot-path check at every seam."""
+    return _active
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _active
+    with _install_lock:
+        _active = plan
+    logger.info("fault plan installed: %s (seed %d)", plan.spec, plan.seed)
+    return plan
+
+
+def uninstall() -> Optional[FaultPlan]:
+    global _active
+    with _install_lock:
+        plan, _active = _active, None
+    return plan
+
+
+def ensure_installed(spec: str, seed: int = 0) -> Optional[FaultPlan]:
+    """Conf-driven install (manager init): idempotent per spec+seed so
+    every manager of an in-process cluster can call it."""
+    if not spec:
+        return None
+    with _install_lock:
+        global _active
+        if _active is not None and _active.spec == spec and _active.seed == seed:
+            return _active
+        _active = FaultPlan.parse(spec, seed=seed)
+    logger.info("fault plan installed from conf: %s (seed %d)", spec, seed)
+    return _active
+
+
+@contextlib.contextmanager
+def installed(plan_or_spec, seed: int = 0):
+    """``with faults.installed("read:fail:2"): ...`` — scoped install."""
+    plan = (
+        plan_or_spec
+        if isinstance(plan_or_spec, FaultPlan)
+        else FaultPlan.parse(plan_or_spec, seed=seed)
+    )
+    prev = active()
+    install(plan)
+    try:
+        yield plan
+    finally:
+        with _install_lock:
+            global _active
+            _active = prev

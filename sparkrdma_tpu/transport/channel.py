@@ -34,6 +34,7 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from sparkrdma_tpu.memory.registry import ProtectionDomain, RegionError
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.transport import wire
 from sparkrdma_tpu.transport.completion import CompletionListener
 from sparkrdma_tpu.utils.config import TpuShuffleConf
@@ -129,6 +130,11 @@ class TpuChannel:
     # ------------------------------------------------------------------
     def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
         """Post RPC segments as SEND WRs; one completion for the batch."""
+        plan = _faults.active()
+        if plan is not None:
+            listener, handled = plan.on_send(self, listener, segments)
+            if handled:
+                return
         payloads = [wire.pack_send(seg) for seg in segments]
         self._m_sends.inc(len(payloads))
         self._m_send_bytes.inc(sum(len(p) for p in payloads))
@@ -147,6 +153,11 @@ class TpuChannel:
         must equal total block length. Completes once for the whole list
         (reference: only the last WR is signaled, :383-390).
         """
+        plan = _faults.active()
+        if plan is not None:
+            listener, handled = plan.on_read(self, listener, dst_views, blocks)
+            if handled:
+                return
         total = sum(b[2] for b in blocks)
         if sum(len(v) for v in dst_views) != total:
             raise ValueError("destination size != total remote block length")

@@ -30,6 +30,7 @@ import numpy as np
 from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
 from sparkrdma_tpu.native import transport_lib as tl
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.transport import wire
 from sparkrdma_tpu.transport.channel import ChannelError
 from sparkrdma_tpu.transport.completion import CompletionListener
@@ -262,6 +263,11 @@ class NativeTpuChannel:
 
     # -- verb API (parity with TpuChannel) -----------------------------
     def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
+        plan = _faults.active()
+        if plan is not None:
+            listener, handled = plan.on_send(self, listener, segments)
+            if handled:
+                return
         segments = [bytes(s) for s in segments]
         self._m_sends.inc(len(segments))
         self._m_send_bytes.inc(sum(len(s) for s in segments))
@@ -277,6 +283,11 @@ class NativeTpuChannel:
         dst_views: List[memoryview],
         blocks: List[Tuple[int, int, int]],
     ) -> None:
+        plan = _faults.active()
+        if plan is not None:
+            listener, handled = plan.on_read(self, listener, dst_views, blocks)
+            if handled:
+                return
         total = sum(b[2] for b in blocks)
         if sum(len(v) for v in dst_views) != total:
             raise ValueError("destination size != total remote block length")
@@ -298,6 +309,12 @@ class NativeTpuChannel:
         same-host file-backed blocks arrive as zero-copy page-cache
         mappings; anything else falls back to one streamed copy. The
         listener owns the delivery and must release() it."""
+        plan = _faults.active()
+        if plan is not None:
+            # dst_views=None marks the mapped (read-only delivery) flavor
+            listener, handled = plan.on_read(self, listener, None, blocks)
+            if handled:
+                return
         self._m_reads.inc(len(blocks))
         self._m_read_bytes.inc(sum(b[2] for b in blocks))
         permits = max(1, len(blocks))

@@ -228,6 +228,55 @@ class TpuShuffleConf:
         """Timeout for driver location fetches (fetcher iterator wrapper)."""
         return self._int("partitionLocationFetchTimeoutMs", 30000, 100, 1 << 30)
 
+    # -- resilience (retry / checksums / circuit breaker; docs/RESILIENCE.md)
+    @property
+    def resilience_checksums(self) -> bool:
+        """Compute per-block crc32c at publish time and validate on
+        fetch (utils/checksum.py). Mismatch = retryable fault."""
+        return self._bool("resilience.checksums", True)
+
+    @property
+    def max_fetch_attempts(self) -> int:
+        """Total attempts per group READ before FetchFailedError:
+        initial, same-source retry, re-resolve failover, split."""
+        return self._int("resilience.maxFetchAttempts", 4, 1, 100)
+
+    @property
+    def retry_backoff_ms(self) -> int:
+        """Base of the exponential retry backoff (deterministic jitter)."""
+        return self._int("resilience.retryBackoffMs", 50, 1, 1 << 20)
+
+    @property
+    def retry_backoff_max_ms(self) -> int:
+        return self._int("resilience.retryBackoffMaxMs", 2000, 1, 1 << 24)
+
+    @property
+    def fetch_deadline_ms(self) -> int:
+        """Wall budget per group across ALL its retries; 0 = unbounded."""
+        return self._int("resilience.fetchDeadlineMs", 0, 0, 1 << 30)
+
+    @property
+    def circuit_failure_threshold(self) -> int:
+        """Consecutive failures that open a peer's circuit breaker."""
+        return self._int("resilience.circuitFailureThreshold", 3, 1, 1 << 16)
+
+    @property
+    def circuit_open_ms(self) -> int:
+        """How long an open circuit fails fast before a half-open probe."""
+        return self._int("resilience.circuitOpenMs", 5000, 1, 1 << 30)
+
+    # -- fault injection (testing/faults.py) ------------------------------
+    @property
+    def fault_plan(self) -> str:
+        """Fault-plan spec installed at manager init (empty = none);
+        grammar in testing/faults.py. Chaos runs set this plus
+        ``faultPlanSeed`` so failures reproduce exactly."""
+        return str(self.get(PREFIX + "faultPlan", "") or "")
+
+    @property
+    def fault_plan_seed(self) -> int:
+        return self._int("faultPlanSeed", 0, 0, 1 << 31)
+
     # -- reduce-side ordering ---------------------------------------------
     @property
     def sort_spill_threshold(self) -> int:

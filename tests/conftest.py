@@ -39,3 +39,8 @@ def pytest_configure(config):
         "markers",
         "tpu: on-chip test (run with SRT_TPU_TESTS=1 python -m pytest -m tpu)",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection test (exercises the resilience retry "
+        "ladder via sparkrdma_tpu.testing.faults or transport seams)",
+    )
