@@ -48,6 +48,14 @@ same planes of this framework on one chip + one host:
   (``flash_vs_xla_dense``). ``flash_train_tflops`` adds the custom
   VJP (blockwise dq / dkdv kernels): one full forward+backward per
   step, so long-context training runs at flash memory cost.
+- ``ab_samehost_fileworkers`` / ``ab_streamed_connections``:
+  interleaved SAME-RUN striped-vs-unstriped A/B pairs (fileWorkers
+  1 vs N on the pread plane; 1 vs M data connections on the streamed
+  plane) — per-pair ratios are immune to the run-to-run rig drift that
+  made cross-round striping comparisons lore.
+- ``flash_attn_mfu`` / ``flash_train_mfu``: the measured TFLOPs over
+  the chip's dense bf16 peak (small public-spec table keyed on
+  ``device_kind``; null off-TPU rather than a made-up peak).
 - ``exchange_loopback_gbps``: the resident ExchangeProgram executable
   on the single-device mesh. Labeled loopback: at E=1 the collective
   degenerates to an on-device pass, so this bounds program overhead;
@@ -326,6 +334,136 @@ def bench_native_reads() -> dict:
     return out
 
 
+def bench_striping_ab() -> dict:
+    """Interleaved striped-vs-unstriped A/B pairs, SAME run.
+
+    The reference stripes READs over multiple QPs (RdmaChannel.java
+    rdma_channel_conn_count); this rig's counterpart levers are the
+    same-host file-worker pool (conf ``fileWorkers``) and multiple data
+    connections on the streamed plane. Round-over-round numbers from
+    DIFFERENT runs can't separate striping from rig drift, so each pair
+    here interleaves A (unstriped) and B (striped) back to back against
+    the SAME server region — per-pair ratios are drift-immune. Both
+    clients/channel sets stay alive across all pairs (workers never
+    shrink; connections are cached), so warm-up cost lands before the
+    first pair, not inside one side of it."""
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport import FnListener
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    out = {}
+    rng = np.random.default_rng(11)
+    srv = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", False, "ab-srv")
+    n_blocks = READ_REGION // READ_BLOCK
+    dsts = [memoryview(bytearray(READ_BLOCK)) for _ in range(n_blocks)]
+    N_PAIRS = 3
+    ROUNDS_PER_SIDE = 4
+
+    def one_round(channels, mkey, label):
+        # round-robin the region's blocks over the channel set (one
+        # entry = unstriped; M entries = striped across M connections)
+        evs, errs = [], []
+        for i in range(n_blocks):
+            ev = threading.Event()
+
+            def fail(e, ev=ev):
+                errs.append(e)
+                ev.set()
+
+            channels[i % len(channels)].read_in_queue(
+                FnListener(lambda _, ev=ev: ev.set(), fail),
+                [dsts[i]], [(mkey, i * READ_BLOCK, READ_BLOCK)],
+            )
+            evs.append(ev)
+        for ev in evs:
+            assert ev.wait(120), f"{label}: A/B read timed out"
+        if errs:
+            raise SystemExit(f"BENCH FAILED: {label} READ error: {errs[0]}")
+
+    def timed_side(channels, mkey, label):
+        t0 = time.perf_counter()
+        for _ in range(ROUNDS_PER_SIDE):
+            one_round(channels, mkey, label)
+        dt = time.perf_counter() - t0
+        return ROUNDS_PER_SIDE * READ_REGION / dt / 1e9
+
+    def run_pairs(ch_a, ch_b, mkey, label):
+        pairs = []
+        for _ in range(N_PAIRS):
+            a = timed_side(ch_a, mkey, label)
+            b = timed_side(ch_b, mkey, label)
+            pairs.append(
+                {"unstriped_gbps": round(a, 3), "striped_gbps": round(b, 3)}
+            )
+        med_a = float(np.median([p["unstriped_gbps"] for p in pairs]))
+        med_b = float(np.median([p["striped_gbps"] for p in pairs]))
+        return {
+            "pairs": pairs,
+            "unstriped_gbps": round(med_a, 3),
+            "striped_gbps": round(med_b, 3),
+            "striped_speedup": round(med_b / med_a, 3) if med_a else None,
+        }
+
+    clients = []
+    try:
+        src = rng.integers(0, 256, size=READ_REGION, dtype=np.uint8)
+        buf = TpuBuffer(srv.pd, READ_REGION, register=True)
+        np.frombuffer(buf.view, dtype=np.uint8)[:] = src
+
+        # --- pair set 1: same-host pread plane, fileWorkers 1 vs N ----
+        conf_s = TpuShuffleConf()  # shipped default worker count
+        cli_u = NativeTpuNode(
+            TpuShuffleConf({"tpu.shuffle.fileWorkers": "1"}),
+            "127.0.0.1", True, "ab-cli-unstriped",
+        )
+        cli_s = NativeTpuNode(conf_s, "127.0.0.1", True, "ab-cli-striped")
+        clients += [cli_u, cli_s]
+        ch_u = [cli_u.get_channel("127.0.0.1", srv.port)]
+        ch_s = [cli_s.get_channel("127.0.0.1", srv.port)]
+        one_round(ch_u, buf.mkey, "samehost-warm")
+        one_round(ch_s, buf.mkey, "samehost-warm")
+        if not np.array_equal(np.frombuffer(dsts[1], np.uint8),
+                              src[READ_BLOCK: 2 * READ_BLOCK]):
+            raise SystemExit("BENCH FAILED: A/B samehost READ bytes differ")
+        res = run_pairs(ch_u, ch_s, buf.mkey, "samehost")
+        res["striped_workers"] = conf_s.file_workers
+        out["ab_samehost_fileworkers"] = res
+
+        # --- pair set 2: streamed plane, 1 vs M data connections ------
+        # fileFastPath=false makes the loopback client behave like a
+        # remote peer: every block rides a socket, so connection count
+        # is the striping lever (purpose-distinct channels are distinct
+        # connections in the native plane's channel cache)
+        M = 4
+        cli_r = NativeTpuNode(
+            TpuShuffleConf({"tpu.shuffle.fileFastPath": "false"}),
+            "127.0.0.1", True, "ab-cli-streamed",
+        )
+        clients.append(cli_r)
+        ch_many = [
+            cli_r.get_channel("127.0.0.1", srv.port, purpose=f"data-{j}")
+            for j in range(M)
+        ]
+        ch_one = ch_many[:1]
+        one_round(ch_many, buf.mkey, "streamed-warm")
+        fast, streamed = cli_r.read_path_stats()
+        if fast != 0 or streamed == 0:
+            raise SystemExit("BENCH FAILED: A/B streamed pull not streamed")
+        if not np.array_equal(np.frombuffer(dsts[1], np.uint8),
+                              src[READ_BLOCK: 2 * READ_BLOCK]):
+            raise SystemExit("BENCH FAILED: A/B streamed READ bytes differ")
+        res = run_pairs(ch_one, ch_many, buf.mkey, "streamed")
+        res["striped_connections"] = M
+        out["ab_streamed_connections"] = res
+        buf.free()
+    finally:
+        for c in clients:
+            c.stop()
+        srv.stop()
+    return out
+
+
 def _socket_roofline() -> float:
     """Raw single-core loopback TCP throughput at the bench's block
     size — the streamed plane's machine limit on this rig. Moves the
@@ -566,6 +704,30 @@ def bench_device(jax) -> dict:
         causal_flops * 3.5 / (train_ms / 1e3) / 1e12, 2
     )
 
+    # --- MFU: measured TFLOPs against the chip's dense bf16 peak --------
+    # peak table from public spec sheets (per device, bf16, no
+    # sparsity); an unlisted kind (CPU, emulator) reports null MFU
+    # rather than a made-up peak
+    _BF16_PEAK_TFLOPS = {
+        "tpu v4": 275.0,
+        "tpu v5 lite": 197.0,
+        "tpu v5e": 197.0,
+        "tpu v5": 459.0,
+        "tpu v5p": 459.0,
+        "tpu v6 lite": 918.0,
+        "tpu v6e": 918.0,
+    }
+    kind = str(getattr(device, "device_kind", "") or "")
+    peak = _BF16_PEAK_TFLOPS.get(kind.strip().lower())
+    out["device_kind"] = kind
+    out["bf16_peak_tflops"] = peak
+    out["flash_attn_mfu"] = (
+        round(out["flash_attn_tflops"] / peak, 4) if peak else None
+    )
+    out["flash_train_mfu"] = (
+        round(out["flash_train_tflops"] / peak, 4) if peak else None
+    )
+
     # --- loopback exchange program executable ---------------------------
     prog = ExchangeProgram(mesh)
     block = 64 << 20
@@ -615,6 +777,7 @@ def main() -> None:
 
     out = {}
     out.update(bench_native_reads())
+    out.update(bench_striping_ab())
     import jax
 
     out.update(bench_device(jax))

@@ -162,36 +162,64 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
     ios = [DeviceShuffleIO(ex) for ex in execs]
     phases = {}
     try:
-        # --- map side + publish, pipelined per executor ----------------
-        # each executor's publish overlaps the next one's sort (the
-        # map-side analogue of the reduce-side fetch/merge overlap);
-        # busy times are informational, the wall is what counts
+        # --- map side: the PIPELINED DEVICE-ACCELERATED map plane ------
+        # WORKLOADS_r05 pinned the e2e loss here: sequential host
+        # np.sort + publish walled 22.95 s. Two structural fixes ride
+        # together (DESIGN.md "Pipelined map plane"):
+        #   1. the O(N log N) sort runs ON DEVICE (MapShardSorter: one
+        #      device_sort + device-side searchsorted per shard; the
+        #      host never sorts),
+        #   2. sort -> stage -> publish run as a bounded three-stage
+        #      pipeline (MapTaskPipeline), so shard k+1 sorts while
+        #      shard k stages into registered memory and shard k-1's
+        #      locations upload.
+        # Busy times per stage come from the pipeline report; the wall
+        # is what counts. conf map.deviceSort=false falls back to the
+        # host sort inside the same pipeline (stage/publish overlap
+        # still applies).
         from concurrent.futures import ThreadPoolExecutor
 
-        t_sort_busy = [0.0] * executors
-        t_pub_busy = [0.0] * executors
-        keep0 = {}  # executor 0's sorted output, reused by the solo probe
+        from sparkrdma_tpu.models import MapShardSorter
+        from sparkrdma_tpu.shuffle.writer.pipeline import MapTaskPipeline
 
-        def map_and_publish(i):
-            ts = time.perf_counter()
-            local = np.sort(shards[i])
-            bounds = np.concatenate(
-                [[0], np.searchsorted(local, edges), [len(local)]]
-            )
-            tm = time.perf_counter()
-            t_sort_busy[i] = tm - ts
-            ios[i].publish_device_blocks(
+        keep0 = {}  # executor 0's sorted output, reused by the solo probe
+        use_device_sort = bool(conf.map_device_sort)
+        shard_sorter = MapShardSorter() if use_device_sort else None
+        t0 = time.perf_counter()
+        if shard_sorter is not None:
+            shard_sorter.warm(n // executors, len(edges))
+        map_compile_s = time.perf_counter() - t0
+
+        def sort_shard(i):
+            if shard_sorter is not None:
+                local, bounds = shard_sorter.sort_partition(shards[i], edges)
+            else:
+                local = np.sort(shards[i])
+                bounds = np.concatenate(
+                    [[0], np.searchsorted(local, edges), [len(local)]]
+                )
+            if i == 0:
+                keep0["local"], keep0["bounds"] = local, bounds
+            return local, bounds
+
+        def stage_shard(i, sorted_out):
+            local, bounds = sorted_out
+            return ios[i].stage_device_blocks(
                 99,
                 {r: local[bounds[r]: bounds[r + 1]] for r in range(reducers)},
             )
-            t_pub_busy[i] = time.perf_counter() - tm
-            if i == 0:
-                keep0["local"], keep0["bounds"] = local, bounds
 
-        t0 = time.perf_counter()
-        with ThreadPoolExecutor(executors) as tp:
-            list(tp.map(map_and_publish, range(executors)))
-        phases["map_publish_wall_s"] = time.perf_counter() - t0
+        def publish_shard(i, locs):
+            ios[i].publish_staged(99, locs, num_map_outputs=1)
+
+        pipe = MapTaskPipeline(
+            sort_shard, stage_shard, publish_shard,
+            parallelism=conf.map_parallelism,
+            depth=conf.map_pipeline_depth,
+            role="e2e-map",
+        )
+        pipe_report = pipe.run(range(executors))
+        phases["map_publish_wall_s"] = pipe_report.wall_s
 
         # publish cost measured UNCONTENDED (solo re-publish of
         # executor 0's retained sorted output to a throwaway shuffle
@@ -438,10 +466,18 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         0.0,
     )
     attribution = {
-        "compute_map_sort_busy_s": round(sum(t_sort_busy), 3),
+        "compute_map_sort_busy_s": round(
+            pipe_report.stage_busy_s["sort"], 3
+        ),
         "compute_merge_on_chip_s_imputed": round(merge_on_chip_total, 3),
+        "framework_map_stage_busy_s": round(
+            pipe_report.stage_busy_s["stage"], 3
+        ),
         "framework_publish_uncontended_s": round(publish_uncontended, 3),
-        "framework_publish_busy_s_contended": round(sum(t_pub_busy), 3),
+        "framework_publish_busy_s_contended": round(
+            pipe_report.stage_busy_s["publish"], 3
+        ),
+        "map_pipeline_overlap_saved_s": round(pipe_report.overlap_s, 3),
         "framework_fetch_transport_s": round(ft, 3),
         "framework_reduce_residual_s": round(reduce_residual, 3),
         "tunnel_fetch_stage_s": round(fs, 3),
@@ -472,7 +508,9 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         vs_host_sort_ex_tunnel=round(t_host / ex_tunnel_total, 3),
         framework_attributable_s=round(framework_attributable, 3),
         attribution=attribution,
-        compile_warm_s=round(phases_compile, 3),
+        map_sorter=("device" if use_device_sort else "host"),
+        map_parallelism=conf.map_parallelism,
+        compile_warm_s=round(phases_compile + map_compile_s, 3),
         verified="count+sum+xor+sorted (on-device)",
         metrics=metrics,
         **extra_busy,

@@ -124,12 +124,19 @@ class ClusterContext:
         )
         self.driver.register_shuffle(handle)
 
+        # group this stage's tasks by worker and ship each group as ONE
+        # map_batch request: one socket round trip per worker instead of
+        # one per map, with the worker's bounded map pool (conf
+        # map.parallelism) running the batch concurrently
+        by_worker: Dict[int, List] = {}
+        for i, fn in enumerate(map_fns):
+            by_worker.setdefault(i % len(self.workers), []).append((i, fn))
         futures = [
             self._pool.submit(
-                self.workers[i % len(self.workers)].request,
-                {"kind": "map", "handle": handle, "map_id": i, "records_fn": fn},
+                self.workers[w].request,
+                {"kind": "map_batch", "handle": handle, "tasks": tasks},
             )
-            for i, fn in enumerate(map_fns)
+            for w, tasks in by_worker.items()
         ]
         for f in futures:
             f.result()  # raise the first map failure

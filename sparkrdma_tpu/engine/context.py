@@ -133,8 +133,12 @@ class TpuContext:
                     writer.stop(False)
                     raise
 
+            # dispatch each map through ITS executor's bounded map pool
+            # (conf map.parallelism) — per-executor concurrency is the
+            # config knob, not an artifact of the context's task pool
             futures = [
-                self._pool.submit(run_map, m) for m in range(parent.num_partitions)
+                self.executor_for_partition(m).map_pool.submit(run_map, m)
+                for m in range(parent.num_partitions)
             ]
             errors = [f.exception() for f in futures if f.exception() is not None]
             if not errors:

@@ -10,8 +10,15 @@ dispatches map/reduce closures (the Spark-core role the reference
 delegates to Spark; closures travel via cloudpickle).
 
 Task protocol (length-prefixed cloudpickle, one request per
-connection): {"kind": "map" | "reduce" | "finalize" | "ping" | "stop",
-...} -> {"ok": bool, "result"/"error": ...}.
+connection): {"kind": "map" | "map_batch" | "reduce" | "finalize" |
+"ping" | "stop", ...} -> {"ok": bool, "result"/"error": ...}.
+
+Map tasks — single or batched — run through the manager's bounded
+``map_pool`` (conf ``map.parallelism``), so per-process map concurrency
+is the config knob regardless of how many task connections the driver
+opens. ``map_batch`` ships a whole stage's tasks for this worker in ONE
+request (one socket round trip instead of one per map) and runs them
+concurrently up to the pool bound.
 """
 
 from __future__ import annotations
@@ -60,19 +67,39 @@ class Worker:
         self.manager.start_node_if_missing()  # hello to driver now
         self._stop = threading.Event()
 
+    def _run_map(self, handle, map_id, records_fn) -> None:
+        writer = self.manager.get_writer(handle, map_id)
+        try:
+            writer.write(records_fn())
+            writer.stop(True)
+        except Exception:
+            writer.stop(False)
+            raise
+
     def handle(self, req):
         kind = req["kind"]
         if kind == "ping":
             return {"ok": True, "result": "pong"}
         if kind == "map":
-            handle = req["handle"]
-            writer = self.manager.get_writer(handle, req["map_id"])
-            try:
-                writer.write(req["records_fn"]())
-                writer.stop(True)
-            except Exception:
-                writer.stop(False)
-                raise
+            # single map: still bounded by the pool so concurrent task
+            # connections can't oversubscribe the process
+            self.manager.map_pool.submit(
+                self._run_map, req["handle"], req["map_id"], req["records_fn"]
+            ).result()
+            return {"ok": True}
+        if kind == "map_batch":
+            # one request, N map tasks, bounded concurrency: every task
+            # goes through the map pool; the first failure propagates
+            # after ALL have settled (writers must reach stop() so a
+            # failed task poisons/aborts cleanly before the reply)
+            futures = [
+                self.manager.map_pool.submit(self._run_map, req["handle"], mid, fn)
+                for mid, fn in req["tasks"]
+            ]
+            errors = [f.exception() for f in futures]
+            errors = [e for e in errors if e is not None]
+            if errors:
+                raise errors[0]
             return {"ok": True}
         if kind == "finalize":
             self.manager.finalize_maps(req["shuffle_id"])

@@ -9,6 +9,6 @@ distributed workloads built on the device exchange plane.
 from sparkrdma_tpu.models.als import ALS
 from sparkrdma_tpu.models.hashjoin import HashJoin
 from sparkrdma_tpu.models.pagerank import PageRank
-from sparkrdma_tpu.models.terasort import TeraSorter
+from sparkrdma_tpu.models.terasort import MapShardSorter, TeraSorter
 
-__all__ = ["ALS", "HashJoin", "PageRank", "TeraSorter"]
+__all__ = ["ALS", "HashJoin", "MapShardSorter", "PageRank", "TeraSorter"]

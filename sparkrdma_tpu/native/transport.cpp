@@ -367,7 +367,12 @@ struct Node {
   // 2-thread pread measures ~1.5x one thread) concurrent read groups
   // overlap their page-cache copies — the thread-pool analogue of the
   // reference posting WR lists on multiple QPs (RdmaChannel.java:54-56).
+  // The vector itself is guarded by fw_mu (srt_set_file_workers can
+  // grow it mid-run); the epoll loop never touches the vector — it
+  // reads the atomic count, published AFTER each thread is live.
   std::vector<std::thread> file_workers;
+  std::mutex fw_mu;
+  std::atomic<size_t> file_worker_count{0};
   std::mutex ft_mu;
   std::condition_variable ft_cv;
   std::deque<FileTask> ftq;
@@ -766,6 +771,22 @@ bool do_file_task_mapped(FileTask& t) {
   return true;
 }
 
+// Reclaim the mappings described by an n x 32B mapped-read record blob
+// (user_ptr, len, map_base, map_len per record, host-endian) that will
+// never reach its consumer. Dropped queued FILE_DONE commands and
+// undelivered aux=1 completions must come through here before their
+// blob is freed, else every record's page-cache mmap leaks for the
+// process lifetime.
+void unmap_mapped_records(const void* recs, size_t len) {
+  const uint8_t* p = (const uint8_t*)recs;
+  for (size_t off = 0; off + 32 <= len; off += 32) {
+    uint64_t base, mlen;
+    memcpy(&base, p + off + 16, sizeof(base));
+    memcpy(&mlen, p + off + 24, sizeof(mlen));
+    if (base) munmap((void*)base, (size_t)mlen);
+  }
+}
+
 bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
   if (t.mapped) return do_file_task_mapped(t);
   uint64_t dst_off = 0;
@@ -1146,10 +1167,10 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         // multi-block pread tasks fan out over the worker pool (the
         // WR-list striping analogue): contiguous block ranges, each
         // part's dst pre-offset, one shared completion. Mapped tasks
-        // stay whole (their records must keep request order). The
-        // worker vector is append-only and fully built before any
-        // channel exists, so reading its size here is safe.
-        size_t nworkers = n->file_workers.size();
+        // stay whole (their records must keep request order). The pool
+        // can grow mid-run (srt_set_file_workers), so read the atomic
+        // count — never the vector, which mutates under fw_mu.
+        size_t nworkers = n->file_worker_count.load(std::memory_order_acquire);
         uint64_t total_bytes = 0;
         for (uint64_t L : t.lens) total_bytes += L;
         // split only when the work amortizes the dispatch (a few MB
@@ -1392,7 +1413,12 @@ void loop_main(Node* n) {
                      cmd.kind == Command::FILE_FALLBACK) {
             auto key = std::make_pair(cmd.channel, cmd.req_id);
             auto fit = n->file_pending.find(key);
-            if (fit != n->file_pending.end()) {
+            if (fit == n->file_pending.end()) {
+              // the pending read is gone (STOP already errored it):
+              // a mapped FILE_DONE still carries live mmap records
+              if (cmd.kind == Command::FILE_DONE && !cmd.data.empty())
+                unmap_mapped_records(cmd.data.data(), cmd.data.size());
+            } else {
               PendingRead pr = std::move(fit->second);
               n->file_pending.erase(fit);
               if (cmd.kind == Command::FILE_DONE) {
@@ -1604,7 +1630,11 @@ void* srt_node_create(const char* host, uint16_t base_port, int max_retries) {
     if (ufd >= 0) close(ufd);
   }
   n->loop = std::thread(loop_main, n);
-  n->file_workers.emplace_back(file_worker_main, n);
+  {
+    std::lock_guard<std::mutex> g(n->fw_mu);
+    n->file_workers.emplace_back(file_worker_main, n);
+    n->file_worker_count.store(1, std::memory_order_release);
+  }
   return n;
 }
 
@@ -1745,7 +1775,10 @@ uint64_t srt_connect(void* np, const char* host, uint16_t port,
   size_t idlen = strlen(my_id);
   std::vector<uint8_t> hello(1 + 4 + 2 + idlen);
   hello[0] = OP_HELLO;
-  store_be32(&hello[1], ((uint32_t)(kind & 0xff) << 24) | my_port);
+  // kind arrives pre-composed from Python as (kind << 8) | index; the
+  // shift lands kind in hello-word byte 3 and the striping index in
+  // byte 2 (wire.split_hello_word layout)
+  store_be32(&hello[1], ((uint32_t)(kind & 0xffff) << 16) | (my_port & 0xffff));
   hello[5] = idlen >> 8;
   hello[6] = idlen & 0xff;
   memcpy(&hello[7], my_id, idlen);
@@ -1874,8 +1907,15 @@ void srt_set_file_workers(void* np, int k) {
   Node* n = (Node*)np;
   if (k < 1) k = 1;
   if (k > 16) k = 16;
-  while ((int)n->file_workers.size() < k && !n->stopping.load())
+  // the vector mutates only under fw_mu; the loop thread reads the
+  // atomic count (published after each thread is live), so growing
+  // after traffic has started is safe
+  std::lock_guard<std::mutex> g(n->fw_mu);
+  while ((int)n->file_workers.size() < k && !n->stopping.load()) {
     n->file_workers.emplace_back(file_worker_main, n);
+    n->file_worker_count.store(n->file_workers.size(),
+                               std::memory_order_release);
+  }
 }
 
 int srt_close_channel(void* np, uint64_t channel) {
@@ -1926,8 +1966,21 @@ void srt_node_stop(void* np) {
   // the worker drains queued tasks (their destination buffers stay
   // alive until this function returns), then exits on `stopping`
   n->ft_cv.notify_all();
-  for (auto& w : n->file_workers)
-    if (w.joinable()) w.join();
+  {
+    std::lock_guard<std::mutex> g(n->fw_mu);
+    for (auto& w : n->file_workers)
+      if (w.joinable()) w.join();
+  }
+  // commands queued behind STOP (or enqueued by workers finishing
+  // after the loop exited) are never drained by the loop; a mapped
+  // FILE_DONE among them still owns its page-cache mmaps
+  {
+    std::lock_guard<std::mutex> g(n->cmd_mu);
+    for (auto& cmd : n->cmds)
+      if (cmd.kind == Command::FILE_DONE && !cmd.data.empty())
+        unmap_mapped_records(cmd.data.data(), cmd.data.size());
+    n->cmds.clear();
+  }
   close(n->listen_fd);
   {
     std::lock_guard<std::mutex> g(n->conn_mu);
@@ -1941,8 +1994,14 @@ void srt_node_stop(void* np) {
   close(n->evfd);
   {
     std::lock_guard<std::mutex> g(n->cq_mu);
-    for (auto& c : n->cq)
-      if (c.payload) free(c.payload);
+    for (auto& c : n->cq) {
+      if (c.payload) {
+        // an undelivered mapped completion (aux=1) owns the mappings
+        // its records describe, not just the record blob
+        if (c.aux == 1) unmap_mapped_records(c.payload, c.payload_len);
+        free(c.payload);
+      }
+    }
     n->cq.clear();
   }
   delete n;

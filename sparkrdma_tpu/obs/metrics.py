@@ -244,9 +244,28 @@ class MetricsRegistry:
                           sort_keys=True)
 
     def reset(self) -> None:
-        """Drop every registered instrument (tests only)."""
+        """Zero every registered instrument in place (tests only).
+
+        Instruments are NOT dropped: modules pre-resolve and cache them
+        at import (e.g. the mempool counters in memory/buffer_manager),
+        so clearing the dict would orphan those references — they would
+        keep counting into objects no snapshot can see for the rest of
+        the process.
+        """
         with self._lock:
-            self._metrics.clear()
+            for m in self._metrics.values():
+                with m._lock:
+                    if isinstance(m, Counter):
+                        m._value = 0
+                    elif isinstance(m, Gauge):
+                        m._value = 0
+                        m._hwm = 0
+                    else:
+                        m._counts = [0] * (len(m.bounds) + 1)
+                        m._count = 0
+                        m._sum = 0.0
+                        m._min = None
+                        m._max = None
 
 
 _DEFAULT = MetricsRegistry()

@@ -75,15 +75,13 @@ class DeviceShuffleIO:
     # ------------------------------------------------------------------
     # map side: device -> registered host memory -> locations
     # ------------------------------------------------------------------
-    def publish_device_blocks(
-        self,
-        shuffle_id: int,
-        partitions: Dict[int, "object"],
-        num_map_outputs: int = 1,
-    ) -> None:
+    def stage_device_blocks(
+        self, shuffle_id: int, partitions: Dict[int, "object"]
+    ) -> List[PartitionLocation]:
         """Stage per-partition device arrays into registered buffers and
-        publish their locations (one publish = one map output for the
-        driver's completeness barrier)."""
+        return their locations WITHOUT publishing — the stage half of
+        the map pipeline, so the next shard's device sort can overlap
+        this shard's driver RPC (publish_staged)."""
         mgr = self._manager
         locs: List[PartitionLocation] = []
         staged = []
@@ -106,11 +104,34 @@ class DeviceShuffleIO:
                     BlockLocation(0, nbytes, buf.mkey),
                 )
             )
+        # buffers go under shuffle ownership as soon as they're staged:
+        # a publish failure (or an aborted pipeline) still releases them
+        # through unpublish/stop
         with self._lock:
             self._published.setdefault(shuffle_id, []).extend(staged)
-        mgr.publish_partition_locations(
+        return locs
+
+    def publish_staged(
+        self,
+        shuffle_id: int,
+        locs: List[PartitionLocation],
+        num_map_outputs: int = 1,
+    ) -> None:
+        """Publish previously staged locations (one publish = one map
+        output for the driver's completeness barrier)."""
+        self._manager.publish_partition_locations(
             shuffle_id, -1, locs, num_map_outputs=num_map_outputs
         )
+
+    def publish_device_blocks(
+        self,
+        shuffle_id: int,
+        partitions: Dict[int, "object"],
+        num_map_outputs: int = 1,
+    ) -> None:
+        """Stage + publish in one call (the non-pipelined composition)."""
+        locs = self.stage_device_blocks(shuffle_id, partitions)
+        self.publish_staged(shuffle_id, locs, num_map_outputs=num_map_outputs)
 
     # ------------------------------------------------------------------
     # reduce side: one-sided READ -> HBM slab

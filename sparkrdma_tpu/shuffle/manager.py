@@ -24,7 +24,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
@@ -93,6 +93,10 @@ class TpuShuffleManager:
 
         self._lock = threading.Lock()
         self._stopped = False
+        # bounded map-task pool (conf map.parallelism): the engine runs
+        # this executor's map tasks through here instead of a sequential
+        # loop, so one executor overlaps several shards' write pipelines
+        self._map_pool: Optional[ThreadPoolExecutor] = None
 
         self.reader_stats = (
             ShuffleReaderStats(conf) if conf.collect_shuffle_read_stats else None
@@ -519,6 +523,20 @@ class TpuShuffleManager:
             self._reader_metrics.append(reader.metrics)
         return reader
 
+    @property
+    def map_pool(self) -> ThreadPoolExecutor:
+        """This executor's bounded map-task pool (lazy; size = conf
+        ``map.parallelism``). Map dispatch layers (engine/context,
+        engine/worker) submit map tasks here so per-executor map
+        concurrency is a config knob, not a scheduler accident."""
+        with self._lock:
+            if self._map_pool is None:
+                self._map_pool = ThreadPoolExecutor(
+                    max_workers=self.conf.map_parallelism,
+                    thread_name_prefix=f"map-{self.executor_id}",
+                )
+            return self._map_pool
+
     def finalize_maps(self, shuffle_id: int) -> None:
         """Map-stage barrier hook: chunked-agg data publishes here."""
         from sparkrdma_tpu.shuffle.writer.chunked_agg import ChunkedAggShuffleData
@@ -601,6 +619,9 @@ class TpuShuffleManager:
             if self._stopped:
                 return
             self._stopped = True
+            map_pool, self._map_pool = self._map_pool, None
+        if map_pool is not None:
+            map_pool.shutdown(wait=True)
         if self.reader_stats is not None:
             self.reader_stats.print_stats()
         self.resolver.stop()

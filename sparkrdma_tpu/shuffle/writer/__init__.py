@@ -41,6 +41,10 @@ from sparkrdma_tpu.shuffle.writer.chunked_agg import (  # noqa: E402
     ChunkedAggShuffleData,
     ChunkedAggShuffleWriter,
 )
+from sparkrdma_tpu.shuffle.writer.pipeline import (  # noqa: E402
+    MapTaskPipeline,
+    PipelineReport,
+)
 
 __all__ = [
     "ShuffleData",
@@ -48,4 +52,6 @@ __all__ = [
     "WrapperShuffleWriter",
     "ChunkedAggShuffleData",
     "ChunkedAggShuffleWriter",
+    "MapTaskPipeline",
+    "PipelineReport",
 ]

@@ -61,6 +61,13 @@ class ChunkedAggShuffleData(ShuffleData):
         self._committed_maps = 0
         self._published = False
         self._poisoned = False
+        # incremental publish (conf map.incrementalPublish): sealed
+        # (non-tail) blocks are immutable, so their locations upload as
+        # maps commit; per-pid cursor of blocks already published
+        self._incremental = bool(
+            getattr(resolver.conf, "map_incremental_publish", False)
+        )
+        self._sealed_published: Dict[int, int] = {}
 
     def partition_writer(self, pid: int) -> PartitionWriter:
         with self._lock:
@@ -79,17 +86,60 @@ class ChunkedAggShuffleData(ShuffleData):
         with self._lock:
             self._active_shuffle_writers += 1
 
-    def commit_map_output(self) -> None:
-        """A map task finished successfully; counts toward the barrier."""
+    def commit_map_output(self, manager=None) -> None:
+        """A map task finished successfully; counts toward the barrier.
+
+        With ``map.incrementalPublish`` on (and a manager to publish
+        through), every SEALED writer block whose location has not gone
+        out yet uploads now, overlapping the remaining map compute.
+        These segments carry ``num_map_outputs=0`` — the driver treats
+        them as location-only and completes the barrier ONLY on the
+        final ``finalize_and_publish`` count, so a fetch can never be
+        answered from a partial location set (tail blocks and the last
+        flushes only ship at finalize)."""
         with self._lock:
             self._active_shuffle_writers -= 1
             self._committed_maps += 1
+            publishable = (
+                self._incremental
+                and manager is not None
+                and not self._poisoned
+                and not self._published
+            )
+            window = []
+            if publishable:
+                for pid, pw in self._writers.items():
+                    sealed = pw.sealed_count()
+                    cursor = self._sealed_published.get(pid, 0)
+                    if sealed > cursor:
+                        window.append((pid, pw, cursor, sealed))
+                        self._sealed_published[pid] = sealed
+        if not window:
+            return
+        locs: List[PartitionLocation] = []
+        for pid, pw, start, end in window:
+            for block_loc in pw.locations_range(start, end):
+                locs.append(
+                    PartitionLocation(manager.local_manager_id, pid, block_loc)
+                )
+        if not locs:
+            return
+        get_registry().counter(
+            "writer.incremental_publishes", role=manager.executor_id
+        ).inc()
+        manager.publish_partition_locations(
+            self.shuffle_id, -1, locs, num_map_outputs=0
+        )
 
     def abort_map_output(self, dirty: bool = False) -> None:
         """A map task failed: it must NOT count toward the driver's
         map-output barrier (its stage will re-run). ``dirty`` means the
         task already flushed frames into the shared logs, which cannot
-        be excised — the whole shuffle's data here is now unpublishable."""
+        be excised — the whole shuffle's data here is now unpublishable.
+        Locations already uploaded incrementally are harmless: the
+        barrier count never went out, so the driver keeps deferring
+        fetches, and the stage re-run's ``unregister_shuffle`` of this
+        id drops them."""
         with self._lock:
             self._active_shuffle_writers -= 1
             if dirty:
@@ -126,9 +176,15 @@ class ChunkedAggShuffleData(ShuffleData):
             self._published = True
             writers = dict(self._writers)
             committed = self._committed_maps
+            cursors = dict(self._sealed_published)
+        # publish everything past each pid's incremental cursor (all of
+        # it when incremental mode is off — cursors are then empty); the
+        # full map-output count rides THIS message, completing the
+        # driver's barrier only once every location is registered there
         locs: List[PartitionLocation] = []
         for pid, pw in writers.items():
-            for block_loc in pw.locations():
+            start = cursors.get(pid, 0)
+            for block_loc in pw.locations_range(start, 1 << 30):
                 locs.append(PartitionLocation(manager.local_manager_id, pid, block_loc))
         reg = get_registry()
         role = manager.executor_id
@@ -233,7 +289,7 @@ class ChunkedAggShuffleWriter:
             buf.free()
         self._recycled.clear()
         if success:
-            self._data.commit_map_output()
+            self._data.commit_map_output(self._manager)
             return MapStatus(self.map_id, self._lengths)
         self._data.abort_map_output(dirty=self._dirty)
         return None

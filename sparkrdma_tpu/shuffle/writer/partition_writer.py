@@ -89,6 +89,23 @@ class PartitionWriter:
         with self._lock:
             return [b.location() for b in self._blocks if b.location().length > 0]
 
+    def sealed_count(self) -> int:
+        """Number of blocks that can no longer change: ``append_frame``
+        only ever writes into the LAST block (or starts a new one), so
+        every non-tail block is immutable — safe to publish before the
+        map barrier (incremental publish, chunked_agg.py)."""
+        with self._lock:
+            return max(0, len(self._blocks) - 1)
+
+    def locations_range(self, start: int, end: int) -> List[BlockLocation]:
+        """Block locations for indices [start, end) — the incremental
+        publisher's cursor window. ``end`` may exceed the current block
+        count (clamped); callers pass ``sealed_count()`` results so the
+        window never includes the mutable tail."""
+        with self._lock:
+            blocks = self._blocks[start:end]
+        return [b.location() for b in blocks if b.location().length > 0]
+
     def input_streams(self) -> List[BinaryIO]:
         with self._lock:
             return [b.input_stream() for b in self._blocks]

@@ -375,7 +375,7 @@ class NativeTpuNode:
         # passive channels per (peer executor_id, kind): an RPC and a
         # DATA connection from the same peer coexist (reference channel
         # roles, RdmaChannel.java:110-154)
-        self._passive: Dict[Tuple[str, int], NativeTpuChannel] = {}
+        self._passive: Dict[Tuple[str, int, int], NativeTpuChannel] = {}
         self._peer_of_channel: Dict[int, str] = {}
         self._connect_locks: Dict[Tuple[str, int, str], threading.Lock] = {}
         self._lock = threading.Lock()
@@ -615,7 +615,7 @@ class NativeTpuNode:
                 else ""
             )
             # aux is the raw 32-bit hello word (wire.pack_hello layout)
-            peer_port, chan_kind = wire.split_hello_word(c.aux)
+            peer_port, chan_kind, chan_index = wire.split_hello_word(c.aux)
             purpose = "data" if chan_kind == wire.KIND_DATA else "rpc"
             get_registry().counter("transport.accepts", purpose=purpose).inc()
             ch = NativeTpuChannel(
@@ -623,8 +623,11 @@ class NativeTpuNode:
             )
             with self._lock:
                 self._channels[c.channel] = ch
-                stale = self._passive.get((peer_id, chan_kind))
-                self._passive[(peer_id, chan_kind)] = ch
+                # keyed by (peer, kind, index): index-distinct striped
+                # data connections from one peer coexist instead of
+                # stale-replacing each other (wire.index_of)
+                stale = self._passive.get((peer_id, chan_kind, chan_index))
+                self._passive[(peer_id, chan_kind, chan_index)] = ch
                 self._peer_of_channel[c.channel] = peer_id
             if stale is not None and stale.is_connected:
                 logger.info("replacing stale passive channel for %s", peer_id)
@@ -713,7 +716,10 @@ class NativeTpuNode:
         payloads never head-of-line block control messages
         (RdmaChannel.java:110-154)."""
         key = (host, port, purpose)
-        kind = wire.kind_of(purpose)
+        # srt_connect's kind arg carries the composed (kind, index) pair;
+        # the C side places it in hello-word bits 31-16 so the acceptor's
+        # wire.split_hello_word sees kind in byte 3, index in byte 2
+        kind = (wire.kind_of(purpose) << 8) | wire.index_of(purpose)
         with self._lock:
             ch = self._active.get(key)
             if ch is not None and ch.is_connected:

@@ -70,9 +70,10 @@ class TpuNode:
 
         self._cpu_vectors = CpuVectorAllocator(conf.cpu_list)
         self._active: Dict[Tuple[str, int, str], TpuChannel] = {}
-        # passive channels per (peer executor_id, kind): an RPC and a
-        # DATA connection from the same peer coexist
-        self._passive: Dict[Tuple[str, int], TpuChannel] = {}
+        # passive channels per (peer executor_id, kind, index): an RPC
+        # and a DATA connection from the same peer coexist, and striped
+        # data-N connections get distinct index slots
+        self._passive: Dict[Tuple[str, int, int], TpuChannel] = {}
         self._lock = threading.Lock()
         self._connect_locks: Dict[Tuple[str, int, str], threading.Lock] = {}
         self._stopped = False
@@ -119,7 +120,7 @@ class TpuNode:
                 if op != wire.OP_HELLO:
                     sock.close()
                     continue
-                peer_port, peer_id, kind = wire.unpack_hello(sock)
+                peer_port, peer_id, kind, index = wire.unpack_hello(sock)
             except OSError:
                 sock.close()
                 continue
@@ -143,11 +144,13 @@ class TpuNode:
                     stale = channel
                     channel = None
                 else:
-                    # passive channels are per (peer, kind): an RPC and a
-                    # DATA connection from the same peer coexist
-                    # (reference channel roles, RdmaChannel.java:110-154)
-                    stale = self._passive.get((peer_id, kind))
-                    self._passive[(peer_id, kind)] = channel
+                    # passive channels are per (peer, kind, index): an RPC
+                    # and a DATA connection from the same peer coexist
+                    # (reference channel roles, RdmaChannel.java:110-154),
+                    # and index-distinct data connections stripe
+                    # (rdma_channel_conn_count analogue)
+                    stale = self._passive.get((peer_id, kind, index))
+                    self._passive[(peer_id, kind, index)] = channel
             if stale is not None and stale.is_connected:
                 # stale-channel replacement (reference :134-148)
                 logger.info("replacing stale passive channel for %s", peer_id)
@@ -157,9 +160,10 @@ class TpuNode:
         lost: Optional[str] = None
         with self._lock:
             stopped = self._stopped
-            for (peer_id, kind), ch in list(self._passive.items()):
+            for key, ch in list(self._passive.items()):
                 if ch is channel:
-                    del self._passive[(peer_id, kind)]
+                    peer_id = key[0]
+                    del self._passive[key]
                     # peer loss is per-peer, not per-channel-flavor: a
                     # dying data channel while the rpc channel is healthy
                     # (or vice versa) must not prune the peer's locations
@@ -234,7 +238,10 @@ class TpuNode:
         )
         sock.settimeout(None)
         sock.sendall(
-            wire.pack_hello(self.port, self.executor_id, wire.kind_of(purpose))
+            wire.pack_hello(
+                self.port, self.executor_id,
+                wire.kind_of(purpose), wire.index_of(purpose),
+            )
         )
         ch = TpuChannel(
             self.conf,

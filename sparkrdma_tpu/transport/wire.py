@@ -98,32 +98,52 @@ def pack_read_err(req_id: int, msg: str) -> bytes:
 KIND_RPC = 0
 KIND_DATA = 1
 
-_KIND_OF_PURPOSE = {"rpc": KIND_RPC, "data": KIND_DATA}
-
-
 def kind_of(purpose: str) -> int:
     """Wire kind for a channel purpose; raises on unknown values so a
-    typo'd purpose can't silently create an RPC-tagged data channel."""
-    try:
-        return _KIND_OF_PURPOSE[purpose]
-    except KeyError:
-        raise ValueError(f"unknown channel purpose {purpose!r} (rpc|data)")
+    typo'd purpose can't silently create an RPC-tagged data channel.
+
+    ``data`` sub-purposes (``data-0``, ``data-1``, ...) all map to
+    KIND_DATA: the channel cache keys on the full purpose string, so
+    distinct sub-purposes are distinct CONNECTIONS to the same peer —
+    the striping lever (reference: rdma_channel_conn_count QP striping,
+    RdmaChannel.java:54-56; here bench.py's 1-vs-M A/B pairs)."""
+    if purpose == "rpc":
+        return KIND_RPC
+    if purpose == "data" or purpose.startswith("data-"):
+        return KIND_DATA
+    raise ValueError(f"unknown channel purpose {purpose!r} (rpc|data[-N])")
 
 
-def pack_hello(port: int, executor_id: str, kind: int = KIND_RPC) -> bytes:
+def index_of(purpose: str) -> int:
+    """Channel index within a (peer, kind): ``data-N`` sub-purposes
+    carry N so the acceptor can keep N striped connections from one
+    peer alive side by side instead of stale-replacing them. ``rpc``
+    and plain ``data`` are index 0 (the legacy encoding, bit-for-bit)."""
+    if purpose.startswith("data-"):
+        try:
+            return int(purpose[5:]) & 0xFF
+        except ValueError:
+            pass
+    return 0
+
+
+def pack_hello(port: int, executor_id: str, kind: int = KIND_RPC,
+               index: int = 0) -> bytes:
     b = executor_id.encode("utf-8")
-    word = (kind << 24) | (port & 0xFFFF)
+    word = (kind << 24) | ((index & 0xFF) << 16) | (port & 0xFFFF)
     return bytes([OP_HELLO]) + _U32.pack(word) + struct.pack(">H", len(b)) + b
 
 
-def split_hello_word(word: int) -> Tuple[int, int]:
-    """(port, kind) from the 4-byte hello word — the single definition
-    of its bit layout, shared with the native plane's ACCEPT aux."""
-    return word & 0xFFFF, (word >> 24) & 0xFF
+def split_hello_word(word: int) -> Tuple[int, int, int]:
+    """(port, kind, index) from the 4-byte hello word — the single
+    definition of its bit layout, shared with the native plane's ACCEPT
+    aux. Byte 2 (bits 23-16) is the striping index, 0 from legacy
+    encoders which always stored 0 there."""
+    return word & 0xFFFF, (word >> 24) & 0xFF, (word >> 16) & 0xFF
 
 
-def unpack_hello(sock: socket.socket) -> Tuple[int, str, int]:
+def unpack_hello(sock: socket.socket) -> Tuple[int, str, int, int]:
     word = _U32.unpack(read_exact(sock, 4))[0]
     (n,) = struct.unpack(">H", read_exact(sock, 2))
-    port, kind = split_hello_word(word)
-    return port, read_exact(sock, n).decode("utf-8"), kind
+    port, kind, index = split_hello_word(word)
+    return port, read_exact(sock, n).decode("utf-8"), kind, index

@@ -277,6 +277,39 @@ class TpuShuffleConf:
     def fault_plan_seed(self) -> int:
         return self._int("faultPlanSeed", 0, 0, 1 << 31)
 
+    # -- map plane (pipelined device-accelerated producer; DESIGN.md) -----
+    @property
+    def map_parallelism(self) -> int:
+        """Bounded map-task pool size per executor process. Map tasks
+        dispatch through this pool instead of a sequential loop, so one
+        executor overlaps several shards' sort/stage/publish stages."""
+        return self._int("map.parallelism", 2, 1, 64)
+
+    @property
+    def map_pipeline_depth(self) -> int:
+        """Bound on items queued between pipeline stages (sort ->
+        stage-into-registered -> publish). Depth 1 still overlaps
+        adjacent stages; deeper queues absorb stage-time jitter at the
+        cost of holding more shards' staging memory live."""
+        return self._int("map.pipelineDepth", 2, 1, 64)
+
+    @property
+    def map_device_sort(self) -> bool:
+        """Sort + range-partition map shards ON-DEVICE (MapShardSorter:
+        device_sort + searchsorted against the reducer edges) instead of
+        the host O(N log N) np.sort the map plane was losing on."""
+        return self._bool("map.deviceSort", True)
+
+    @property
+    def map_incremental_publish(self) -> bool:
+        """Chunked-agg incremental publish: sealed (non-tail, immutable)
+        writer blocks publish their locations as map tasks commit, so
+        location upload overlaps remaining map compute; the map-barrier
+        count still rides ONLY the final publish (num_map_outputs=0 on
+        incremental segments), so the driver never answers fetches from
+        a partial location set."""
+        return self._bool("map.incrementalPublish", False)
+
     # -- reduce-side ordering ---------------------------------------------
     @property
     def sort_spill_threshold(self) -> int:

@@ -198,7 +198,7 @@ def test_rpc_data_channel_split_python_plane():
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:
             with a._lock:
-                kinds = sorted(k for p, k in a._passive if p == "hol-cli")
+                kinds = sorted(k[1] for k in a._passive if k[0] == "hol-cli")
             if len(kinds) == 2:
                 break
             time.sleep(0.01)
@@ -229,7 +229,7 @@ def test_rpc_data_channel_split_python_plane():
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:
             with a._lock:
-                left = [k for p, k in a._passive if p == "hol-cli"]
+                left = [k[1] for k in a._passive if k[0] == "hol-cli"]
             if len(left) == 1:
                 break
             time.sleep(0.01)
