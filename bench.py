@@ -334,6 +334,134 @@ def bench_native_reads() -> dict:
     return out
 
 
+def bench_consume_pipelined_ab() -> dict:
+    """Interleaved serial-vs-pipelined consume A/B pairs, SAME run.
+
+    BENCH_r05 pinned the reduce-side loss: same-host native READ
+    sustains ~4 GB/s raw but only ~1.5 GB/s fetch-to-CONSUMED against a
+    ~2.4 GB/s consume roofline — the READ wait and the consume pass ran
+    strictly in sequence. The reduce pipeline's lever (DESIGN.md §16)
+    is to keep the next group's READs in flight under the current
+    group's consume; this A/B isolates exactly that on the same-host
+    pread plane. The A side is today's serial loop (the
+    ``native_read_samehost_consumed_gbps`` shape: read a region, then
+    sum it). The B side double-buffers two destination sets: round
+    k+1's preads (C++ file workers — the GIL is released) land while
+    round k is consumed (``np.add.reduce`` — also GIL-free), same total
+    volume and the same consume pass per byte. Same interleaved-pair
+    methodology as :func:`bench_striping_ab`, so per-pair ratios are
+    drift-immune; both sides verify the summed payload."""
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport import FnListener
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    out = {}
+    rng = np.random.default_rng(13)
+    srv = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", False, "cab-srv")
+    cli = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", True, "cab-cli")
+    n_blocks = READ_REGION // READ_BLOCK
+    N_PAIRS = 3
+    ROUNDS_PER_SIDE = 4
+    dsts_a = [memoryview(bytearray(READ_BLOCK)) for _ in range(n_blocks)]
+    dsts_b = [memoryview(bytearray(READ_BLOCK)) for _ in range(n_blocks)]
+    try:
+        ch = cli.get_channel("127.0.0.1", srv.port)
+        src = rng.integers(0, 256, size=READ_REGION, dtype=np.uint8)
+        buf = TpuBuffer(srv.pd, READ_REGION, register=True)
+        np.frombuffer(buf.view, dtype=np.uint8)[:] = src
+        want_round = int(np.add.reduce(src, dtype=np.int64))
+
+        def issue(dsts):
+            evs, errs = [], []
+            for i in range(n_blocks):
+                ev = threading.Event()
+
+                def fail(e, ev=ev):
+                    errs.append(e)
+                    ev.set()
+
+                ch.read_in_queue(
+                    FnListener(lambda _, ev=ev: ev.set(), fail),
+                    [dsts[i]], [(buf.mkey, i * READ_BLOCK, READ_BLOCK)],
+                )
+                evs.append(ev)
+            return evs, errs
+
+        def wait(evs, errs):
+            for ev in evs:
+                assert ev.wait(120), "consume A/B read timed out"
+            if errs:
+                raise SystemExit(
+                    f"BENCH FAILED: consume A/B READ error: {errs[0]}"
+                )
+
+        def consume(dsts):
+            s = 0
+            for d in dsts:
+                s += int(
+                    np.add.reduce(np.frombuffer(d, np.uint8), dtype=np.int64)
+                )
+            return s
+
+        def serial_side():
+            sink = 0
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS_PER_SIDE):
+                wait(*issue(dsts_a))
+                sink += consume(dsts_a)
+            dt = time.perf_counter() - t0
+            return ROUNDS_PER_SIDE * READ_REGION / dt / 1e9, sink
+
+        def pipelined_side():
+            sink = 0
+            t0 = time.perf_counter()
+            pend = issue(dsts_a)
+            cur, nxt = dsts_a, dsts_b
+            for r in range(ROUNDS_PER_SIDE):
+                wait(*pend)
+                if r + 1 < ROUNDS_PER_SIDE:
+                    pend = issue(nxt)
+                sink += consume(cur)
+                cur, nxt = nxt, cur
+            dt = time.perf_counter() - t0
+            return ROUNDS_PER_SIDE * READ_REGION / dt / 1e9, sink
+
+        # warm: connection, fd + page cache, BOTH destination sets
+        # faulted in (the B side must not pay first-touch the A side
+        # already paid)
+        wait(*issue(dsts_a))
+        wait(*issue(dsts_b))
+        fast, _ = cli.read_path_stats()
+        if fast == 0:
+            raise SystemExit(
+                "BENCH FAILED: consume A/B never took the fast path"
+            )
+        pairs = []
+        for _ in range(N_PAIRS):
+            a, sink_a = serial_side()
+            b, sink_b = pipelined_side()
+            if (sink_a != want_round * ROUNDS_PER_SIDE
+                    or sink_b != want_round * ROUNDS_PER_SIDE):
+                raise SystemExit("BENCH FAILED: consume A/B sums differ")
+            pairs.append(
+                {"serial_gbps": round(a, 3), "pipelined_gbps": round(b, 3)}
+            )
+        med_a = float(np.median([p["serial_gbps"] for p in pairs]))
+        med_b = float(np.median([p["pipelined_gbps"] for p in pairs]))
+        out["ab_consume_pipelined"] = {
+            "pairs": pairs,
+            "native_read_samehost_consumed_gbps": round(med_a, 3),
+            "native_read_samehost_consumed_pipelined_gbps": round(med_b, 3),
+            "pipelined_speedup": round(med_b / med_a, 3) if med_a else None,
+        }
+        buf.free()
+    finally:
+        cli.stop()
+        srv.stop()
+    return out
+
+
 def bench_striping_ab() -> dict:
     """Interleaved striped-vs-unstriped A/B pairs, SAME run.
 
@@ -777,6 +905,7 @@ def main() -> None:
 
     out = {}
     out.update(bench_native_reads())
+    out.update(bench_consume_pipelined_ab())
     out.update(bench_striping_ab())
     import jax
 

@@ -177,8 +177,6 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         # is what counts. conf map.deviceSort=false falls back to the
         # host sort inside the same pipeline (stage/publish overlap
         # still applies).
-        from concurrent.futures import ThreadPoolExecutor
-
         from sparkrdma_tpu.models import MapShardSorter
         from sparkrdma_tpu.shuffle.writer.pipeline import MapTaskPipeline
 
@@ -340,25 +338,75 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
             per_merge_on_chip = t_hi / 9
         merge_on_chip_total = per_merge_on_chip * reducers
 
-        # fetch/compute overlap (SURVEY §2.3): the next reducer's
-        # READ + HBM staging runs on a worker thread while the device
-        # merges the current one — the e2e exercises the same overlap
-        # the fetcher gives record-plane readers. Phase timers count
-        # BUSY time per plane; with overlap their sum exceeds wall.
-        t_fetch = t_merge = 0.0
-
-        def fetch_one(r):
-            nonlocal t_fetch
-            t0 = time.perf_counter()
-            got = reducer_io.fetch_device_blocks(
-                99, r, r + 1, dtype=np.uint32, timeout_s=120
-            )
-            t_fetch += time.perf_counter() - t0
-            return got[r]
+        # fetch/compute overlap (SURVEY §2.3, DESIGN.md §16): the
+        # reduce side runs on the ReduceTaskPipeline — group READs for
+        # reducer k+2 in flight while k+1's checksum verify runs on the
+        # decode pool, k's host->HBM staging rides under k-1's device
+        # merge (double-buffered staging). r05's 1-deep prefetch loop
+        # fused transport+verify+stage into one blocking call; the
+        # split-phase DeviceShuffleIO API lets each plane's busy clock
+        # tick on its own pipeline stage.
+        from sparkrdma_tpu.shuffle.reader.pipeline import ReduceTaskPipeline
 
         reducer_io = ios[0]
+
+        def fetch_blocks(r):
+            got = reducer_io.fetch_host_blocks(99, r, r + 1, timeout_s=120)
+            return got.get(r, [])
+
+        def verify_blocks(_r, blocks):
+            return [reducer_io.verify_host_block(hb) for hb in blocks]
+
+        def stage_blocks(_r, blocks):
+            return [
+                reducer_io.stage_host_block(hb, dtype=np.uint32)
+                for hb in blocks
+            ]
+
+        def merge_group(_r, bufs):
+            # pin the set device-resident across the direct .array
+            # access (no-op unless HBM pressure spilled some; members
+            # are never victims while pinned)
+            with reducer_io.device_buffers.pinned_on_device(bufs):
+                cap = max(b.array.shape[0] for b in bufs)
+                arrs = tuple(
+                    b.array
+                    if b.array.shape[0] == cap
+                    else jnp.zeros((cap,), jnp.uint32)
+                    .at[: b.array.shape[0]]
+                    .set(b.array)
+                    for b in bufs
+                )
+                counts = jnp.asarray(
+                    [b.length // 4 for b in bufs], jnp.int32
+                )
+                merged, packed = merge(arrs, counts)
+            jax.block_until_ready(merged)
+            for b in bufs:
+                b.free()
+            return packed  # tiny, stays on device
+
+        def discard_group(stage, _item, value):
+            # abort drain: host blocks release, device slabs free;
+            # merge outputs (packed scalar rows) hold no resources
+            if not value:
+                return
+            if stage in ("fetch", "decode"):
+                for hb in value:
+                    hb.release()
+            elif stage == "stage":
+                for b in value:
+                    b.free()
+
+        pipe = ReduceTaskPipeline(
+            fetch_blocks, verify_blocks, stage_blocks, merge_group,
+            parallelism=conf.reduce_parallelism,
+            depth=conf.reduce_pipeline_depth,
+            double_buffer=conf.reduce_double_buffer_staging,
+            role="e2e-reduce",
+            discard_fn=discard_group,
+        )
         t_wall0 = time.perf_counter()
-        pool = ThreadPoolExecutor(1, thread_name_prefix="e2e-fetch")
         # Verification scalars stay ON DEVICE until every merge is done,
         # then come back in ONE batched readback. Measured on this rig
         # (DESIGN.md §13): reading back ANY output of a large program
@@ -366,42 +414,8 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         # transfer stalls 13-25 s — interleaved per-reducer readbacks
         # were 7x-ing the whole fetch/stage plane (150-200 s of stalls
         # at 1 GiB). Deferring the readbacks pays that cost once.
-        packed_rows = []
-        try:
-            fut = pool.submit(fetch_one, 0)
-            for r in range(reducers):
-                bufs = fut.result()
-                if r + 1 < reducers:
-                    fut = pool.submit(fetch_one, r + 1)
-                t0 = time.perf_counter()
-                # pin the set device-resident across the direct .array
-                # access (no-op unless HBM pressure spilled some;
-                # members are never victims while pinned)
-                with reducer_io.device_buffers.pinned_on_device(bufs):
-                    cap = max(b.array.shape[0] for b in bufs)
-                    arrs = tuple(
-                        b.array
-                        if b.array.shape[0] == cap
-                        else jnp.zeros((cap,), jnp.uint32)
-                        .at[: b.array.shape[0]]
-                        .set(b.array)
-                        for b in bufs
-                    )
-                    counts = jnp.asarray(
-                        [b.length // 4 for b in bufs], jnp.int32
-                    )
-                    merged, packed = merge(arrs, counts)
-                packed_rows.append(packed)  # tiny, stays on device
-                jax.block_until_ready(merged)
-                for b in bufs:
-                    b.free()
-                del merged
-                t_merge += time.perf_counter() - t0
-        finally:
-            # a verification failure or fetch fault must not tear down
-            # executors underneath the in-flight prefetch, nor hang
-            # interpreter exit joining a 120 s fetch
-            pool.shutdown(wait=False, cancel_futures=True)
+        reduce_report = pipe.run(range(reducers))
+        packed_rows = reduce_report.results
         # ONE readback for all reducers: [count, sum, xor, sorted] rows
         t0 = time.perf_counter()
         stats = np.asarray(jax.device_get(jnp.stack(packed_rows)))
@@ -420,12 +434,17 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         # only wall time counts toward the total; per-plane busy times
         # are informational (they overlap)
         phases["reduce_wall_s"] = reduce_wall
+        rbusy = reduce_report.stage_busy_s
+        t_fetch = rbusy["fetch"] + rbusy["stage"]
+        t_merge = rbusy["merge"]
         extra_busy = {
             "fetch_stage_busy_s": round(t_fetch, 3),
+            "framework_decode_busy_s": round(rbusy["decode"], 3),
             "device_merge_busy_s": round(t_merge, 3),
             "verify_readback_s": round(t_readback, 3),
-            "overlap_saved_s": round(
-                max(0.0, t_fetch + t_merge - reduce_wall), 3
+            "overlap_saved_s": round(reduce_report.overlap_s, 3),
+            "reduce_pipeline_overlap_saved_s": round(
+                reduce_report.overlap_s, 3
             ),
         }
         t_merge_final = t_merge
@@ -461,6 +480,7 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
     reduce_residual = max(
         phases["reduce_wall_s"]
         - extra_busy["fetch_stage_busy_s"]
+        - extra_busy["framework_decode_busy_s"]
         - t_merge_final
         - extra_busy["verify_readback_s"],
         0.0,
@@ -510,6 +530,9 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         attribution=attribution,
         map_sorter=("device" if use_device_sort else "host"),
         map_parallelism=conf.map_parallelism,
+        reduce_parallelism=conf.reduce_parallelism,
+        reduce_pipeline_depth=conf.reduce_pipeline_depth,
+        reduce_double_buffer=conf.reduce_double_buffer_staging,
         compile_warm_s=round(phases_compile + map_compile_s, 3),
         verified="count+sum+xor+sorted (on-device)",
         metrics=metrics,

@@ -61,6 +61,26 @@ class PickleSerializer(Serializer):
                 raise EOFError("truncated record stream")
             yield loads(data)
 
+    def load_buffer(self, buf):
+        """Zero-copy ``load_stream`` over an in-memory buffer
+        (bytes/bytearray/memoryview): records deserialize straight from
+        slices of ``buf`` — no BytesIO wrapper, no per-record ``read``
+        copies. ``pickle.loads`` accepts buffer objects, so the only
+        materialization is the record tuples themselves."""
+        view = buf if isinstance(buf, memoryview) else memoryview(buf)
+        unpack_from = _LEN.unpack_from
+        loads = pickle.loads
+        pos, end = 0, len(view)
+        while end - pos >= 4:
+            (n,) = unpack_from(view, pos)
+            pos += 4
+            if n == 0:
+                return
+            if end - pos < n:
+                raise EOFError("truncated record stream")
+            yield loads(view[pos : pos + n])
+            pos += n
+
 
 class CompressionCodec:
     """zlib stream codec (Spark's lz4 role). Level 1: shuffle wants speed."""
@@ -74,7 +94,11 @@ class CompressionCodec:
             return data
         return zlib.compress(data, self.level)
 
-    def decompress(self, data: bytes) -> bytes:
+    def decompress(self, data) -> bytes:
+        """Accepts bytes OR a memoryview (zlib reads any buffer): the
+        read path hands wire slices straight in without copying. With
+        compression off the input passes through unchanged — consumers
+        must treat the result as a buffer, not assume ``bytes``."""
         if not self.enabled:
             return data
         return zlib.decompress(data)
@@ -120,7 +144,15 @@ class CompressedBlockWriter:
 
 
 def iter_compressed_blocks(inp: BinaryIO, codec: CompressionCodec) -> Iterator[bytes]:
-    """Read side: yield decompressed blocks until the stream is exhausted."""
+    """Read side: yield decompressed blocks until the stream is exhausted.
+
+    Streams exposing ``read_view`` (MemoryviewInputStream: registered
+    slices, mapped page-cache windows) are sliced zero-copy — the
+    compressed frame never materializes as a bytes object. Yielded
+    blocks derived from such views are only valid until the stream
+    closes; consumers decode fully before closing.
+    """
+    read_block = getattr(inp, "read_view", inp.read)
     while True:
         header = inp.read(4)
         if len(header) < 4:
@@ -128,7 +160,7 @@ def iter_compressed_blocks(inp: BinaryIO, codec: CompressionCodec) -> Iterator[b
         (n,) = _LEN.unpack(header)
         if n == 0:
             return
-        block = inp.read(n)
+        block = read_block(n)
         if len(block) < n:
             raise EOFError("truncated compressed block")
         yield codec.decompress(block)

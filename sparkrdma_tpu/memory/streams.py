@@ -42,6 +42,22 @@ class MemoryviewInputStream(io.RawIOBase):
         self._pos += n
         return out
 
+    def read_view(self, size: int = -1) -> memoryview:
+        """Zero-copy ``read``: a memoryview slice of the backing buffer
+        instead of a bytes copy. The slice is only guaranteed valid
+        until :meth:`close` — the backing registered buffer / mapped
+        window recycles then — so consumers must finish decoding
+        (decompress / deserialize) before closing the stream.
+        """
+        if self._view is None:
+            raise ValueError("read on closed stream")
+        if size is None or size < 0:
+            size = len(self._view) - self._pos
+        n = min(size, len(self._view) - self._pos)
+        out = self._view[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
     def close(self) -> None:
         # release the exported view eagerly so the owning buffer/mapping
         # can be freed deterministically at dispose time

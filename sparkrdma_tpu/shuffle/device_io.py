@@ -31,10 +31,142 @@ from sparkrdma_tpu.ops.hbm_arena import (
     _size_class,
 )
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
 from sparkrdma_tpu.utils import checksum as _checksum
 
 logger = logging.getLogger(__name__)
+
+
+def _start_read_mapped(mgr, arrivals, idx, loc, ch):
+    """Issue one mapped-delivery READ (native transport): no pooled
+    destination buffer at all. Same-host blocks arrive as zero-copy
+    page-cache mappings; remote ones as one malloc'd blob. Each
+    in-flight read OWNS its delivery through its completion listener:
+    whoever turns out to be the last owner (caller or listener)
+    releases — never a timeout racing a late payload. Returns
+    ``(loc, box, done, errbox, abandon_or_reclaim)``; every completion
+    (success or failure) posts ``idx`` to ``arrivals``."""
+    done = threading.Event()
+    errbox: list = []
+    box: dict = {}
+    lock = threading.Lock()
+    owner = {"who": "caller"}
+
+    def on_ok(delivery):
+        box["d"] = delivery
+        done.set()
+        with lock:
+            release = owner["who"] == "listener" and not owner.get("done")
+            if release:
+                owner["done"] = True
+        if release and delivery is not None:
+            delivery.release()
+        arrivals.put(idx)
+
+    def on_fail(e):
+        errbox.append(e)
+        done.set()
+        arrivals.put(idx)
+
+    def abandon_or_reclaim():
+        with lock:
+            if done.is_set():
+                completed = not owner.get("done")
+                owner["done"] = True
+            else:
+                owner["who"] = "listener"
+                completed = False
+        if completed:
+            d = box.get("d")
+            if d is not None:
+                d.release()
+
+    ch.read_mapped_in_queue(
+        FnListener(on_ok, on_fail),
+        [(loc.block.mkey, loc.block.address, loc.block.length)],
+    )
+    return (loc, box, done, errbox, abandon_or_reclaim)
+
+
+def _start_read(mgr, arrivals, idx, loc, reg, ch):
+    """Issue one buffer-landing READ into pooled registered memory
+    ``reg``. Same ownership dance and return shape as
+    :func:`_start_read_mapped` (the second element is ``reg``)."""
+    done = threading.Event()
+    errbox: list = []
+    lock = threading.Lock()
+    owner = {"who": "caller"}  # flipped to "listener" on abandon
+
+    def on_done(err=None):
+        if err is not None:
+            errbox.append(err)
+        done.set()
+        with lock:
+            # on_failure may legally fire more than once; recycle
+            # exactly once
+            recycle = owner["who"] == "listener" and not owner.get("recycled")
+            if recycle:
+                owner["recycled"] = True
+        if recycle:
+            mgr.buffer_manager.put(reg)
+        # duplicate posts are harmless: the arrival loop skips
+        # indices it has already consumed
+        arrivals.put(idx)
+
+    def abandon_or_reclaim():
+        """Caller gives up: recycle now if the read already
+        completed, else hand ownership to the listener."""
+        with lock:
+            if done.is_set():
+                completed = True
+            else:
+                owner["who"] = "listener"
+                completed = False
+        if completed:
+            mgr.buffer_manager.put(reg)
+
+    ch.read_in_queue(
+        FnListener(lambda _: on_done(), on_done),
+        [reg.view[: loc.block.length]],
+        [(loc.block.mkey, loc.block.address, loc.block.length)],
+    )
+    return (loc, reg, done, errbox, abandon_or_reclaim)
+
+
+class HostBlock:
+    """A fetched-but-unverified shuffle block in host memory — the
+    hand-off unit between the reduce pipeline's fetch stage (transport:
+    :meth:`DeviceShuffleIO.fetch_host_blocks`) and its decode/staging
+    stages (:meth:`verify_host_block` / :meth:`stage_host_block`).
+
+    ``view`` spans the whole backing resource (a full slab-class pooled
+    buffer, a local registered span, or a mapped window) so staging can
+    hit ``stage_view``'s copy-free branch; payload bytes are
+    ``data`` (= ``view[:length]``). ``release()`` is idempotent and
+    returns the backing resource to wherever it came from."""
+
+    __slots__ = ("shuffle_id", "loc", "length", "view", "kind", "_release", "_released")
+
+    def __init__(self, shuffle_id, loc, view, kind, release):
+        self.shuffle_id = shuffle_id
+        self.loc = loc
+        self.length = loc.block.length
+        self.view = view
+        self.kind = kind  # "local" | "buffer" | "mapped"
+        self._release = release
+        self._released = False
+
+    @property
+    def data(self):
+        return self.view[: self.length]
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        if self._release is not None:
+            self._release()
 
 
 class DeviceShuffleIO:
@@ -208,96 +340,6 @@ class DeviceShuffleIO:
         # rather than when issue order reaches them
         arrivals: "queue.Queue[int]" = queue.Queue()
 
-        def start_read_mapped(idx, loc, ch):
-            """Mapped-delivery flavor (native transport): no pooled
-            destination buffer at all. Same-host blocks arrive as
-            zero-copy page-cache mappings; remote ones as one malloc'd
-            blob. Ownership dance mirrors start_read: whoever turns out
-            to be the last owner (caller or listener) releases."""
-            done = threading.Event()
-            errbox: list = []
-            box: dict = {}
-            lock = threading.Lock()
-            owner = {"who": "caller"}
-
-            def on_ok(delivery):
-                box["d"] = delivery
-                done.set()
-                with lock:
-                    release = (
-                        owner["who"] == "listener" and not owner.get("done")
-                    )
-                    if release:
-                        owner["done"] = True
-                if release and delivery is not None:
-                    delivery.release()
-                arrivals.put(idx)
-
-            def on_fail(e):
-                errbox.append(e)
-                done.set()
-                arrivals.put(idx)
-
-            def abandon_or_reclaim():
-                with lock:
-                    if done.is_set():
-                        completed = not owner.get("done")
-                        owner["done"] = True
-                    else:
-                        owner["who"] = "listener"
-                        completed = False
-                if completed:
-                    d = box.get("d")
-                    if d is not None:
-                        d.release()
-
-            ch.read_mapped_in_queue(
-                FnListener(on_ok, on_fail),
-                [(loc.block.mkey, loc.block.address, loc.block.length)],
-            )
-            return (loc, box, done, errbox, abandon_or_reclaim)
-
-        def start_read(idx, loc, reg, ch):
-            done = threading.Event()
-            errbox: list = []
-            lock = threading.Lock()
-            owner = {"who": "caller"}  # flipped to "listener" on abandon
-
-            def on_done(err=None):
-                if err is not None:
-                    errbox.append(err)
-                done.set()
-                with lock:
-                    # on_failure may legally fire more than once; recycle
-                    # exactly once
-                    recycle = owner["who"] == "listener" and not owner.get("recycled")
-                    if recycle:
-                        owner["recycled"] = True
-                if recycle:
-                    mgr.buffer_manager.put(reg)
-                # duplicate posts are harmless: the arrival loop skips
-                # indices it has already consumed
-                arrivals.put(idx)
-
-            def abandon_or_reclaim():
-                """Caller gives up: recycle now if the read already
-                completed, else hand ownership to the listener."""
-                with lock:
-                    if done.is_set():
-                        completed = True
-                    else:
-                        owner["who"] = "listener"
-                        completed = False
-                if completed:
-                    mgr.buffer_manager.put(reg)
-
-            ch.read_in_queue(
-                FnListener(lambda _: on_done(), on_done),
-                [reg.view[: loc.block.length]],
-                [(loc.block.mkey, loc.block.address, loc.block.length)],
-            )
-            return (loc, reg, done, errbox, abandon_or_reclaim)
-
         try:
             for loc in locations:
                 if loc.manager_id.executor_id == my_id:
@@ -322,10 +364,14 @@ class DeviceShuffleIO:
                     continue
                 ch = mgr.get_channel_to(loc.manager_id, purpose="data")
                 if mapped_delivery_enabled(conf, ch):
-                    pending.append(start_read_mapped(len(pending), loc, ch))
+                    pending.append(
+                        _start_read_mapped(mgr, arrivals, len(pending), loc, ch)
+                    )
                 else:
                     reg = mgr.buffer_manager.get(loc.block.length)
-                    pending.append(start_read(len(pending), loc, reg, ch))
+                    pending.append(
+                        _start_read(mgr, arrivals, len(pending), loc, reg, ch)
+                    )
 
             remaining = {i for i, e in enumerate(pending) if e is not None}
             refetched: set = set()
@@ -397,10 +443,10 @@ class DeviceShuffleIO:
                     ).inc()
                     ch = mgr.get_channel_to(loc.manager_id, purpose="data")
                     if isinstance(obj, dict):
-                        pending[idx] = start_read_mapped(idx, loc, ch)
+                        pending[idx] = _start_read_mapped(mgr, arrivals, idx, loc, ch)
                     else:
                         reg2 = mgr.buffer_manager.get(loc.block.length)
-                        pending[idx] = start_read(idx, loc, reg2, ch)
+                        pending[idx] = _start_read(mgr, arrivals, idx, loc, reg2, ch)
                     continue
                 mgr.health.record_success(loc.manager_id.executor_id)
                 ts = time.perf_counter()
@@ -450,6 +496,252 @@ class DeviceShuffleIO:
             reg.histogram("device_fetch.transport_ms").observe(t_transport * 1e3)
             reg.histogram("device_fetch.stage_ms").observe(t_stage * 1e3)
             reg.counter("device_fetch.bytes").inc(n_bytes)
+
+    # ------------------------------------------------------------------
+    # reduce side, split-phase: the ReduceTaskPipeline's stage bodies
+    # (DESIGN.md §16). fetch_host_blocks is transport only; checksum
+    # verification moves to verify_host_block (a decode-pool worker) and
+    # host->HBM transfer to stage_host_block (the staging thread), so
+    # the three overlap across groups instead of serializing per block
+    # the way fetch_device_blocks does.
+    # ------------------------------------------------------------------
+    def fetch_host_blocks(
+        self,
+        shuffle_id: int,
+        start_partition: int,
+        end_partition: int,
+        timeout_s: Optional[float] = None,
+    ) -> Dict[int, List[HostBlock]]:
+        """Transport half of a reduce-group fetch: pull every block of
+        ``[start, end)`` into host memory and return unverified
+        :class:`HostBlock` handles (pid -> blocks, each list in
+        completion order). No checksum, no HBM staging — those belong
+        to :meth:`verify_host_block` / :meth:`stage_host_block` on
+        later pipeline stages. Same single-deadline semantics and
+        ownership rules as :meth:`fetch_device_blocks`; the caller owns
+        every returned handle (``release()`` in a finally)."""
+        mgr = self._manager
+        conf = mgr.conf
+        if timeout_s is None:
+            timeout_s = conf.fetch_location_timeout_ms / 1000.0
+        t_transport = 0.0
+        n_bytes = 0
+        deadline = time.monotonic() + timeout_s
+        future = mgr.fetch_remote_partition_locations(
+            shuffle_id, start_partition, end_partition
+        )
+        tw = time.perf_counter()
+        try:
+            locations: List[PartitionLocation] = future.result(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
+        except Exception as e:
+            raise MetadataFetchFailedError(shuffle_id, start_partition, str(e))
+        finally:
+            t_transport += time.perf_counter() - tw
+
+        out: Dict[int, List[HostBlock]] = {}
+        my_id = mgr.executor_id
+        pending: List[Optional[Tuple]] = []
+        arrivals: "queue.Queue[int]" = queue.Queue()
+        try:
+            for loc in locations:
+                if loc.manager_id.executor_id == my_id:
+                    # local short-circuit: the handle aliases the
+                    # publisher's registered span directly (released by
+                    # unpublish, so release() is a no-op); span up to a
+                    # full slab class for stage_view's copy-free branch
+                    pd = mgr.node.pd
+                    avail = (
+                        pd.region_length(loc.block.mkey) - loc.block.address
+                    )
+                    span = min(_size_class(loc.block.length), avail)
+                    view = pd.resolve(loc.block.mkey, loc.block.address, span)
+                    n_bytes += loc.block.length
+                    out.setdefault(loc.partition_id, []).append(
+                        HostBlock(shuffle_id, loc, view, "local", None)
+                    )
+                    continue
+                ch = mgr.get_channel_to(loc.manager_id, purpose="data")
+                if mapped_delivery_enabled(conf, ch):
+                    pending.append(
+                        _start_read_mapped(mgr, arrivals, len(pending), loc, ch)
+                    )
+                else:
+                    reg = mgr.buffer_manager.get(loc.block.length)
+                    pending.append(
+                        _start_read(mgr, arrivals, len(pending), loc, reg, ch)
+                    )
+
+            remaining = {i for i in range(len(pending))}
+            while remaining:
+                budget = deadline - time.monotonic()
+                tw = time.perf_counter()
+                try:
+                    if budget > 0:
+                        idx = arrivals.get(timeout=budget)
+                    else:
+                        idx = arrivals.get_nowait()
+                except queue.Empty:
+                    t_transport += time.perf_counter() - tw
+                    slow = pending[next(iter(remaining))][0]
+                    raise FetchFailedError(
+                        slow.manager_id, shuffle_id, -1, slow.partition_id,
+                        f"fetch deadline ({timeout_s:.1f}s) exceeded with "
+                        f"{len(remaining)} block(s) outstanding",
+                    )
+                t_transport += time.perf_counter() - tw
+                if idx not in remaining:
+                    continue  # duplicate completion post
+                loc, obj, done, errbox, _abandon = pending[idx]
+                if not done.is_set():
+                    continue
+                if errbox:
+                    mgr.health.record_failure(loc.manager_id.executor_id)
+                    raise FetchFailedError(
+                        loc.manager_id, shuffle_id, -1, loc.partition_id,
+                        str(errbox[0]),
+                    )
+                mgr.health.record_success(loc.manager_id.executor_id)
+                if isinstance(obj, dict):
+                    d = obj["d"]
+                    view = d.views[0] if d.views else memoryview(b"")
+                    hb = HostBlock(shuffle_id, loc, view, "mapped", d.release)
+                else:
+                    hb = HostBlock(
+                        shuffle_id, loc, obj.view, "buffer",
+                        lambda o=obj: mgr.buffer_manager.put(o),
+                    )
+                n_bytes += loc.block.length
+                pending[idx] = None
+                remaining.discard(idx)
+                out.setdefault(loc.partition_id, []).append(hb)
+            return out
+        except Exception:
+            for blocks in out.values():
+                for hb in blocks:
+                    hb.release()
+            for entry in pending:
+                if entry is None:
+                    continue
+                entry[4]()  # abandon_or_reclaim
+            raise
+        finally:
+            with self._lock:
+                self._fetch_stats["fetch_transport_s"] += t_transport
+                self._fetch_stats["fetch_bytes"] += n_bytes
+            reg_ = get_registry()
+            reg_.histogram("device_fetch.transport_ms").observe(t_transport * 1e3)
+            reg_.counter("device_fetch.bytes").inc(n_bytes)
+
+    def _refetch_host_block(self, hb: HostBlock) -> HostBlock:
+        """One bounded synchronous re-read of a block whose payload
+        failed the decode-stage checksum gate. ``hb`` must already be
+        released by the caller."""
+        mgr = self._manager
+        loc = hb.loc
+        if loc.manager_id.executor_id == mgr.executor_id:
+            pd = mgr.node.pd
+            avail = pd.region_length(loc.block.mkey) - loc.block.address
+            span = min(_size_class(loc.block.length), avail)
+            view = pd.resolve(loc.block.mkey, loc.block.address, span)
+            return HostBlock(hb.shuffle_id, loc, view, "local", None)
+        conf = mgr.conf
+        timeout_s = conf.fetch_location_timeout_ms / 1000.0
+        arrivals: "queue.Queue[int]" = queue.Queue()
+        ch = mgr.get_channel_to(loc.manager_id, purpose="data")
+        tw = time.perf_counter()
+        if mapped_delivery_enabled(conf, ch):
+            entry = _start_read_mapped(mgr, arrivals, 0, loc, ch)
+        else:
+            reg = mgr.buffer_manager.get(loc.block.length)
+            entry = _start_read(mgr, arrivals, 0, loc, reg, ch)
+        _loc, obj, done, errbox, abandon = entry
+        ok = done.wait(timeout_s)
+        t = time.perf_counter() - tw
+        with self._lock:
+            self._fetch_stats["fetch_transport_s"] += t
+            if ok and not errbox:
+                self._fetch_stats["fetch_bytes"] += loc.block.length
+        get_registry().histogram("device_fetch.transport_ms").observe(t * 1e3)
+        if not ok:
+            abandon()  # read still in flight: listener becomes the owner
+            raise FetchFailedError(
+                loc.manager_id, hb.shuffle_id, -1, loc.partition_id,
+                f"refetch deadline ({timeout_s:.1f}s) exceeded",
+            )
+        if errbox:
+            abandon()  # completed with error: recycles the destination
+            mgr.health.record_failure(loc.manager_id.executor_id)
+            raise FetchFailedError(
+                loc.manager_id, hb.shuffle_id, -1, loc.partition_id,
+                str(errbox[0]),
+            )
+        get_registry().counter("device_fetch.bytes").inc(loc.block.length)
+        if isinstance(obj, dict):
+            d = obj["d"]
+            view = d.views[0] if d.views else memoryview(b"")
+            return HostBlock(hb.shuffle_id, loc, view, "mapped", d.release)
+        return HostBlock(
+            hb.shuffle_id, loc, obj.view, "buffer",
+            lambda o=obj: mgr.buffer_manager.put(o),
+        )
+
+    def verify_host_block(self, hb: HostBlock) -> HostBlock:
+        """Decode-stage integrity gate (runs on a decode-pool worker):
+        validate ``hb`` against its published checksum. A mismatch
+        earns one synchronous same-source refetch, then
+        FetchFailedError — the same ladder as the fused path, moved off
+        the transport thread so refetches stall one group's decode, not
+        every group's fetch. Returns the verified handle (possibly a
+        fresh one; the failed one is released). The ``stage`` fault
+        seam (``stage=decode``) fires here, modeling corruption that
+        happens AFTER the wire delivered intact bytes."""
+        mgr = self._manager
+        my_id = mgr.executor_id
+        plan = _faults.active()
+        if plan is not None:
+            plan.on_stage("decode", [hb.data])
+        loc = hb.loc
+        if _checksum.verify(hb.data, loc.block.checksum, loc.block.checksum_algo):
+            return hb
+        hb.release()
+        reg_ = get_registry()
+        reg_.counter("resilience.checksum_failures", role=my_id).inc()
+        reg_.counter("resilience.retries", role=my_id).inc()
+        fresh = self._refetch_host_block(hb)
+        if _checksum.verify(
+            fresh.data, loc.block.checksum, loc.block.checksum_algo
+        ):
+            mgr.health.record_success(loc.manager_id.executor_id)
+            return fresh
+        fresh.release()
+        reg_.counter("resilience.checksum_failures", role=my_id).inc()
+        mgr.health.record_failure(loc.manager_id.executor_id)
+        raise FetchFailedError(
+            loc.manager_id, hb.shuffle_id, -1, loc.partition_id,
+            "checksum mismatch persisted across refetch",
+        )
+
+    def stage_host_block(self, hb: HostBlock, dtype=np.uint8) -> DeviceBuffer:
+        """Host -> HBM half (runs on the staging thread): transfer a
+        verified block into a pooled device slab and release the host
+        resource. ``stage_view`` blocks until the device transfer
+        completes, so releasing right after is safe. The ``stage``
+        fault seam (``stage=stage``) fires before the transfer."""
+        plan = _faults.active()
+        if plan is not None:
+            plan.on_stage("stage", [hb.data])
+        ts = time.perf_counter()
+        try:
+            dev = self._dev.stage_view(hb.view, hb.length, dtype)
+        finally:
+            hb.release()
+            t = time.perf_counter() - ts
+            with self._lock:
+                self._fetch_stats["fetch_stage_s"] += t
+            get_registry().histogram("device_fetch.stage_ms").observe(t * 1e3)
+        return dev
 
     # ------------------------------------------------------------------
     def metrics_snapshot(self) -> dict:

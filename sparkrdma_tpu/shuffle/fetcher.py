@@ -626,6 +626,14 @@ class TpuShuffleFetcherIterator:
                 stream.close()
             except Exception:
                 logger.exception("closing unconsumed stream failed")
+        # wake a next() blocked on the results queue (the pipelined
+        # reader's fetch thread waits there while ANOTHER thread closes;
+        # the serial path always closed from the consuming thread): the
+        # dummy makes it re-check has_next, now False. Posted AFTER the
+        # sweep so the sweep can't consume it; if nothing is waiting it
+        # sits in the dead queue — later next() calls see has_next
+        # False before ever blocking.
+        self._results.put(_Dummy())
 
     def _drain_pending(self) -> None:
         """Start queued fetches now under the in-flight cap (:369-379)."""

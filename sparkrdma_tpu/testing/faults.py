@@ -1,12 +1,17 @@
 """Fault injection as a first-class subsystem (docs/RESILIENCE.md).
 
 Promotes the ad-hoc monkeypatching the fault tests started with into a
-seeded, config-driven *fault plan* hooked at three seams:
+seeded, config-driven *fault plan* hooked at four seams:
 
   - ``read``  — one-sided READ verbs (``TpuChannel.read_in_queue``,
     ``NativeTpuChannel.read_in_queue`` / ``read_mapped_in_queue``)
   - ``send``  — two-sided SEND verbs (RPC segment posts)
   - ``rpc``   — message dispatch (``TpuShuffleManager._receive_listener``)
+  - ``stage`` — the reduce pipeline's post-transport stages
+    (``DeviceShuffleIO.verify_host_block`` = ``stage=decode``,
+    ``DeviceShuffleIO.stage_host_block`` = ``stage=stage``): corrupt a
+    block AFTER the wire delivered it intact, proving the decode-stage
+    checksum gate catches what the transport-level gates cannot see
 
 Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
 ``delay`` (sleep ``delay_ms`` then proceed), ``corrupt`` (flip one
@@ -19,7 +24,9 @@ Plans are spec strings — ``op:kind:count[:k=v[,k=v...]]`` joined with
 ``faultPlanSeed``), pytest parametrization, and ``bench.py
 --fault-plan`` identically. ``count`` 0 means unlimited. Options:
 ``after=N`` (skip the first N matching ops), ``delay_ms=N``,
-``peer=SUBSTR`` (match on the channel's peer description).
+``peer=SUBSTR`` (match on the channel's peer description),
+``stage=NAME`` (restrict a ``stage`` rule to one pipeline stage, e.g.
+``stage:corrupt:1:stage=decode``).
 
 The plan installs process-globally (:func:`install` /
 :func:`uninstall` / the :func:`installed` context manager); the hot
@@ -39,7 +46,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-OPS = ("read", "send", "rpc")
+OPS = ("read", "send", "rpc", "stage")
 KINDS = ("fail", "delay", "corrupt", "drop")
 
 
@@ -57,6 +64,7 @@ class FaultRule:
     after: int = 0
     delay_ms: int = 0
     peer: str = ""
+    stage: str = ""  # restrict a "stage" rule to one pipeline stage
 
     def __post_init__(self):
         if self.op not in OPS:
@@ -85,6 +93,7 @@ class FaultRule:
             after=int(opts.pop("after", 0)),
             delay_ms=int(opts.pop("delay_ms", 0)),
             peer=opts.pop("peer", ""),
+            stage=opts.pop("stage", ""),
         )
 
 
@@ -126,7 +135,9 @@ class FaultPlan:
                 if (op is None or o == op) and (kind is None or k == kind)
             )
 
-    def _match(self, op: str, peer: str) -> Optional[Tuple[FaultRule, int]]:
+    def _match(
+        self, op: str, peer: str, stage: str = ""
+    ) -> Optional[Tuple[FaultRule, int]]:
         """First applicable rule for this op, or None. Decrements its
         budget and returns (rule, global fire index) when it fires."""
         with self._lock:
@@ -134,6 +145,8 @@ class FaultPlan:
                 if rule.op != op:
                     continue
                 if rule.peer and rule.peer not in peer:
+                    continue
+                if rule.stage and rule.stage != stage:
                     continue
                 self._seen[i] += 1
                 if self._seen[i] <= rule.after:
@@ -233,6 +246,29 @@ class FaultPlan:
         mutated = bytearray(payload)
         self._flip_byte(mutated, fire_index)
         return bytes(mutated), False
+
+    def on_stage(self, stage: str, views) -> None:
+        """Reduce-pipeline seam (DeviceShuffleIO decode/staging): fired
+        with the block's host views AFTER transport delivered them
+        intact. ``corrupt`` flips one byte in place — the adversary the
+        decode-stage checksum gate exists for; ``fail``/``drop`` raise
+        :class:`InjectedFault` (a failed decode); ``delay`` stalls the
+        stage body. Read-only views (mapped page-cache windows) can't
+        be corrupted honestly, so ``corrupt`` degrades to a raise."""
+        hit = self._match("stage", "", stage=stage)
+        if hit is None:
+            return
+        rule, fire_index = hit
+        logger.info("fault plan: %s in pipeline stage %s", rule.kind, stage)
+        if rule.kind == "delay":
+            time.sleep(rule.delay_ms / 1000.0)
+            return
+        if rule.kind == "corrupt":
+            for v in views:
+                if len(v) and not getattr(v, "readonly", True):
+                    self._flip_byte(v, fire_index)
+                    return
+        raise InjectedFault(f"injected {rule.kind} in pipeline stage {stage}")
 
 
 def _drop_channel(channel) -> None:

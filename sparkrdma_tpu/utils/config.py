@@ -310,6 +310,31 @@ class TpuShuffleConf:
         a partial location set."""
         return self._bool("map.incrementalPublish", False)
 
+    # -- reduce plane (pipelined consume; DESIGN.md §16) ------------------
+    @property
+    def reduce_parallelism(self) -> int:
+        """Decode-pool size of the reduce pipeline: workers doing
+        checksum verify + decompress + deserialize off the fetch
+        thread. 1 degenerates to the serial decode order exactly (the
+        sequencer preserves delivery order at ANY parallelism)."""
+        return self._int("reduce.parallelism", 2, 1, 64)
+
+    @property
+    def reduce_pipeline_depth(self) -> int:
+        """Bound on items queued between reduce-pipeline stages (fetch
+        -> decode pool -> stage -> merge/deliver). Depth 1 still
+        overlaps adjacent stages; deeper queues absorb jitter at the
+        cost of holding more fetched groups' memory live."""
+        return self._int("reduce.pipelineDepth", 2, 1, 64)
+
+    @property
+    def reduce_double_buffer_staging(self) -> bool:
+        """Run host->HBM staging and device merge on separate pipeline
+        threads so the tunnel transfer of group k+1 rides under the
+        merge of group k (double-buffered staging). Off serializes
+        stage and merge on one thread."""
+        return self._bool("reduce.doubleBufferStaging", True)
+
     # -- reduce-side ordering ---------------------------------------------
     @property
     def sort_spill_threshold(self) -> int:

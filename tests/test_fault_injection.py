@@ -337,6 +337,104 @@ def test_fault_plan_corruption_detected_and_refetched():
     assert recomputes == 0, "corruption should be absorbed below the engine"
 
 
+def test_stage_seam_corrupt_in_decode_detected_and_reordered():
+    """The ``stage`` fault seam (DESIGN.md §16): a block corrupted in
+    the reduce pipeline's DECODE stage — after the wire delivered it
+    intact, so no transport-level gate can see it — is caught by
+    verify_host_block's checksum, refetched once, and the pipeline
+    still delivers every group in source order with correct bytes."""
+    import numpy as np
+
+    from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
+    from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.shuffle.reader.pipeline import ReduceTaskPipeline
+    from sparkrdma_tpu.testing import faults
+
+    reg = get_registry()
+    before_detect = reg.snapshot(prefix="resilience.checksum_failures")
+    before_retry = reg.snapshot(prefix="resilience.retries")
+    conf = TpuShuffleConf()
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="stg-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="stg-1")
+    ex1.start_node_if_missing()
+    regs = []
+    try:
+        rng = np.random.default_rng(5)
+        payloads = []
+        locs = []
+        for p in range(4):
+            payload = rng.integers(0, 256, 48_000, np.uint8).tobytes()
+            payloads.append(payload)
+            buf = ex1.buffer_manager.get(len(payload))
+            regs.append(buf)
+            np.frombuffer(buf.view, np.uint8, len(payload))[:] = (
+                np.frombuffer(payload, np.uint8)
+            )
+            locs.append(
+                PartitionLocation(
+                    ex1.local_manager_id, p,
+                    BlockLocation(0, len(payload), buf.mkey),
+                )
+            )
+        ex1.publish_partition_locations(77, -1, locs, num_map_outputs=1)
+
+        io = DeviceShuffleIO(ex0)
+        delivered = []
+
+        def fetch_group(r):
+            return io.fetch_host_blocks(77, r, r + 1, timeout_s=30)[r]
+
+        def verify_group(r, blocks):
+            # the decode-stage gate: the seam below corrupts ONE
+            # fetched payload right here, past every transport check
+            return [io.verify_host_block(hb) for hb in blocks]
+
+        def take_bytes(r, blocks):
+            out = [bytes(hb.data) for hb in blocks]
+            for hb in blocks:
+                hb.release()
+            return (r, out)
+
+        def discard(stage, _item, value):
+            if stage in ("fetch", "decode") and value:
+                for hb in value:
+                    hb.release()
+
+        pipe = ReduceTaskPipeline(
+            fetch_group, verify_group, take_bytes, None,
+            parallelism=2, depth=2, double_buffer=False,
+            role="t-stage-seam", discard_fn=discard,
+        )
+        with faults.installed("stage:corrupt:1:stage=decode", seed=7) as plan:
+            results = list(pipe.stream(range(4)))
+        try:
+            assert plan.injected_count("stage", "corrupt") == 1, (
+                "the decode-stage corruption never fired"
+            )
+            # in-order delivery AND correct bytes despite the refetch
+            assert [r for r, _ in results] == [0, 1, 2, 3]
+            for r, blobs in results:
+                assert blobs == [payloads[r]], f"group {r} bytes differ"
+            detected = _counter_total(
+                reg.delta(before_detect, prefix="resilience.checksum_failures")
+            )
+            retried = _counter_total(
+                reg.delta(before_retry, prefix="resilience.retries")
+            )
+            assert detected >= 1, "corruption fired but never detected"
+            assert retried >= 1, "detection without a refetch"
+        finally:
+            io.stop()
+    finally:
+        for buf in regs:
+            ex1.buffer_manager.put(buf)
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
 def test_circuit_breaker_opens_and_fails_fast():
     """Persistent failures open the per-peer breaker; subsequent fetch
     attempts fail fast (counter proves the short-circuit) instead of

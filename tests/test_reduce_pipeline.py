@@ -1,0 +1,254 @@
+"""Pipelined reduce plane (DESIGN.md §16): stage overlap is real and
+measured via reader.pipeline.*, delivery order is invariant under decode
+parallelism, and abort/early-close drains release every in-flight
+item's resources (pool returns == gets)."""
+
+import time
+
+import pytest
+
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.shuffle.reader.pipeline import ReduceTaskPipeline
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+# ---------------------------------------------------------------------------
+# pipeline overlap
+# ---------------------------------------------------------------------------
+
+def test_reduce_pipeline_stages_overlap():
+    """With per-stage sleeps the sum of stage busy time must exceed the
+    wall — the overlap the pipeline exists to buy — and the
+    reader.pipeline.* metrics must record it."""
+    get_registry().reset()
+    d = 0.04
+
+    def mk(stage):
+        def fn(i, *_prev):
+            time.sleep(d)
+            return (stage, i)
+
+        return fn
+
+    pipe = ReduceTaskPipeline(
+        mk("fetch"), mk("decode"), mk("stage"), mk("merge"),
+        parallelism=2, depth=2, double_buffer=True, role="t-overlap",
+    )
+    report = pipe.run(range(6))
+    assert report.results == [("merge", i) for i in range(6)]
+    # 6 items x 4 stages x d of busy; a serial loop would wall 24d.
+    assert report.busy_total_s > report.wall_s
+    assert report.overlap_s > 0
+
+    snap = get_registry().snapshot(prefix="reader.pipeline")
+    stage_keys = [k for k in snap["histograms"] if "stage_ms" in k]
+    for s in ("fetch", "decode", "stage", "merge"):
+        assert any(f"stage={s}" in k for k in stage_keys)
+    for k in stage_keys:
+        if "role=t-overlap" in k:
+            assert snap["histograms"][k]["count"] == 6
+    (ok,) = [
+        k for k in snap["histograms"]
+        if "overlap_ms" in k and "role=t-overlap" in k
+    ]
+    assert snap["histograms"][ok]["sum"] > 0
+    # every item left the pipeline: the inflight gauge is back to zero
+    (gk,) = [
+        k for k in snap["gauges"] if "inflight" in k and "role=t-overlap" in k
+    ]
+    assert snap["gauges"][gk]["value"] == 0
+    assert snap["gauges"][gk]["hwm"] >= 2  # bounded concurrency happened
+
+
+# ---------------------------------------------------------------------------
+# ordering under parallelism
+# ---------------------------------------------------------------------------
+
+def test_reduce_pipeline_parallelism_preserves_order():
+    """The sequencer re-orders decode-pool output to source order:
+    parallelism=4 with adversarial per-item decode skew delivers the
+    EXACT sequence parallelism=1 (today's serial ordering) does."""
+
+    def decode_fn(i, fetched):
+        # items 0, 3, 6, ... decode slow: under parallelism their
+        # successors finish first and sit in the reorder buffer
+        time.sleep(0.03 if i % 3 == 0 else 0.001)
+        return ("dec", i)
+
+    def run(parallelism):
+        pipe = ReduceTaskPipeline(
+            None, decode_fn, None, None,
+            parallelism=parallelism, depth=3, double_buffer=False,
+            role=f"t-order-{parallelism}",
+        )
+        return list(pipe.stream(range(10)))
+
+    serial = run(1)
+    assert serial == [("dec", i) for i in range(10)]
+    assert run(4) == serial
+
+
+# ---------------------------------------------------------------------------
+# abort / early close drain without delivering or leaking
+# ---------------------------------------------------------------------------
+
+def test_reduce_pipeline_abort_drains_without_delivering():
+    """The first decode error latches: the failed item and the tail of
+    the batch never deliver, every fetched item is delivered OR
+    discarded exactly once, and the error re-raises after the drain."""
+    get_registry().reset()
+    acquired, released, delivered = [], [], []
+
+    def fetch_fn(i):
+        acquired.append(i)
+        return ("blk", i)
+
+    def decode_fn(i, blk):
+        if i == 3:
+            raise RuntimeError("decode boom")
+        time.sleep(0.005)
+        return ("dec", i)
+
+    def discard_fn(stage, item, value):
+        released.append((stage, item))
+
+    pipe = ReduceTaskPipeline(
+        fetch_fn, decode_fn, None, None,
+        parallelism=2, depth=2, double_buffer=False, role="t-abort",
+        discard_fn=discard_fn,
+    )
+    with pytest.raises(RuntimeError, match="decode boom"):
+        for out in pipe.stream(range(8)):
+            delivered.append(out)
+    assert ("dec", 3) not in delivered
+    assert len(delivered) < 8
+    # exactly-once resource accounting: pool returns == gets
+    assert len(delivered) + len(released) == len(acquired)
+    snap = get_registry().snapshot(prefix="reader.pipeline")
+    (gk,) = [
+        k for k in snap["gauges"] if "inflight" in k and "role=t-abort" in k
+    ]
+    assert snap["gauges"][gk]["value"] == 0
+
+
+def test_reduce_pipeline_early_close_drains():
+    """A consumer abandoning the stream mid-run (generator close) takes
+    the abort path: everything in flight drains through discard_fn, no
+    item is lost and the inflight gauge returns to zero."""
+    get_registry().reset()
+    acquired, released = [], []
+
+    def fetch_fn(i):
+        acquired.append(i)
+        return ("blk", i)
+
+    def decode_fn(i, blk):
+        time.sleep(0.005)
+        return ("dec", i)
+
+    def discard_fn(stage, item, value):
+        released.append((stage, item))
+
+    pipe = ReduceTaskPipeline(
+        fetch_fn, decode_fn, None, None,
+        parallelism=2, depth=2, double_buffer=False, role="t-close",
+        discard_fn=discard_fn,
+    )
+    stream = pipe.stream(range(16))
+    first = next(stream)
+    assert first == ("dec", 0)
+    stream.close()  # synchronous: returns after the drain completes
+    assert len(acquired) >= 1
+    # the one delivered item + every discarded one == every fetched one
+    assert 1 + len(released) == len(acquired)
+    snap = get_registry().snapshot(prefix="reader.pipeline")
+    (gk,) = [
+        k for k in snap["gauges"] if "inflight" in k and "role=t-close" in k
+    ]
+    assert snap["gauges"][gk]["value"] == 0
+
+
+# ---------------------------------------------------------------------------
+# real reader: pipelined output byte-identical, no pool leaks
+# ---------------------------------------------------------------------------
+
+def _counter(snap, name):
+    return sum(
+        v for k, v in snap["counters"].items() if k.split("{")[0] == name
+    )
+
+
+def _pool_balance(snap):
+    """Outstanding registered-pool buffers: gets minus (returns+frees)."""
+    gets = _counter(snap, "mempool.hits") + _counter(snap, "mempool.misses")
+    return gets - _counter(snap, "mempool.returns") - _counter(snap, "mempool.frees")
+
+
+def _run_cluster_read(parallelism, abandon_after=None):
+    """One-executor cluster (local fetches: deterministic stream order),
+    two map outputs, read everything back — or abandon the reader after
+    ``abandon_after`` records. Returns the consumed record list."""
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "wrapper",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleReadBlockSize": "65536",
+            "tpu.shuffle.reduce.parallelism": str(parallelism),
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex = TpuShuffleManager(conf, is_driver=False, executor_id="rp-0")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=2, partitioner=HashPartitioner(2)
+        )
+        driver.register_shuffle(handle)
+        records = [(f"key-{i % 53}", i) for i in range(1500)]
+        for map_id in range(2):
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(records))
+            w.stop(True)
+        ex.finalize_maps(0)
+        reader = ex.get_reader(handle, 0, 2)
+        out = []
+        try:
+            for rec in reader.read():
+                out.append(rec)
+                if abandon_after is not None and len(out) >= abandon_after:
+                    break
+        finally:
+            reader.close()
+        return out
+    finally:
+        ex.stop()
+        driver.stop()
+
+
+def test_reader_pipelined_output_byte_identical():
+    """reduce.parallelism=1 (the serial loop's ordering) and =4 must
+    deliver the exact same record sequence, and neither run may leak
+    pooled registered buffers."""
+    snap0 = get_registry().snapshot(prefix="mempool")
+    base0 = _pool_balance(snap0)
+    serial = _run_cluster_read(1)
+    assert len(serial) == 3000
+    pipelined = _run_cluster_read(4)
+    assert pipelined == serial
+    snap1 = get_registry().snapshot(prefix="mempool")
+    assert _pool_balance(snap1) == base0, "reader leaked pooled buffers"
+
+
+def test_reader_early_close_releases_streams():
+    """Abandoning a pipelined read mid-stream must still release every
+    fetched stream's registered slice: pool returns == gets once the
+    managers stop."""
+    snap0 = get_registry().snapshot(prefix="mempool")
+    base0 = _pool_balance(snap0)
+    got = _run_cluster_read(4, abandon_after=10)
+    assert len(got) == 10
+    snap1 = get_registry().snapshot(prefix="mempool")
+    assert _pool_balance(snap1) == base0, (
+        "early-closed reader leaked pooled buffers"
+    )
