@@ -903,6 +903,15 @@ def main() -> None:
         plan = faults.FaultPlan.parse(args.fault_plan, seed=args.fault_seed)
         faults.install(plan)
 
+    # time-resolved telemetry: a local hub + one heartbeater make the
+    # artifact a timeline instead of an end-state snapshot
+    from sparkrdma_tpu.obs.telemetry import Heartbeater, TelemetryHub
+
+    hub = TelemetryHub(role="bench", interval_ms=250)
+    heartbeater = Heartbeater(
+        get_registry(), "bench-proc", interval_ms=250, send=hub.ingest
+    ).start()
+
     out = {}
     out.update(bench_native_reads())
     out.update(bench_consume_pipelined_ab())
@@ -910,6 +919,7 @@ def main() -> None:
     import jax
 
     out.update(bench_device(jax))
+    heartbeater.stop(flush=True)
     value = out["native_read_samehost_gbps"]
     trace_path = os.environ.get("SRT_TRACE_OUT", "bench_trace.json")
     try:
@@ -933,7 +943,10 @@ def main() -> None:
         ),
         "obs_registry": get_registry().snapshot(),
         "trace_file": trace_path,
+        "telemetry_timeline": hub.timeline(),
+        "stragglers": hub.straggler_report(),
     }
+    hub.stop()
     if plan is not None:
         record["fault_plan"] = {
             "spec": args.fault_plan,

@@ -851,10 +851,21 @@ if __name__ == "__main__":
         ap.error("--only e2e requires --e2e-gb > 0")
     if args.e2e_gb > 0:
         runs["e2e"] = lambda: bench_e2e_terasort(args.e2e_gb, args.transport)
+
+    from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+    from sparkrdma_tpu.obs.telemetry import Heartbeater, TelemetryHub
+
+    # time-resolved telemetry across the whole run: the artifact gets a
+    # timeline + straggler report, not just the end-state registry
+    hub = TelemetryHub(role="workloads", interval_ms=500)
+    heartbeater = Heartbeater(
+        get_registry(), "workloads-proc", interval_ms=500, send=hub.ingest
+    ).start()
+
     for name, fn in runs.items():
         if args.only in (None, name):
             fn()
-    from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+    heartbeater.stop(flush=True)
 
     trace_out = args.trace_out or (f"{args.out}.trace.json" if args.out else None)
     if trace_out:
@@ -874,8 +885,11 @@ if __name__ == "__main__":
                     "workloads": RECORDS,
                     "obs_registry": get_registry().snapshot(),
                     "trace_file": trace_out,
+                    "telemetry_timeline": hub.timeline(),
+                    "stragglers": hub.straggler_report(),
                 },
                 f, indent=1,
             )
             f.write("\n")
         print(f"wrote {args.out} ({len(RECORDS)} workloads)", flush=True)
+    hub.stop()

@@ -87,6 +87,18 @@ class ClusterContext:
         for w in self.workers:
             assert w.request({"kind": "ping"}) == "pong"
 
+        # telemetry pull loop: drain each worker's heartbeat outbox over
+        # the task protocol and fold it into the driver hub. A worker
+        # that fails a poll is skipped this round (its gap shows up as a
+        # missed heartbeat), never a job failure.
+        self._telemetry_stop = threading.Event()
+        self._telemetry_thread: Optional[threading.Thread] = None
+        if self.driver.telemetry is not None:
+            self._telemetry_thread = threading.Thread(
+                target=self._poll_telemetry, name="telemetry-poll", daemon=True
+            )
+            self._telemetry_thread.start()
+
     @staticmethod
     def _await_port(proc: subprocess.Popen, timeout_s: float) -> int:
         deadline = time.monotonic() + timeout_s
@@ -98,6 +110,21 @@ class ClusterContext:
             if line.startswith("WORKER_PORT "):
                 return int(line.split()[1])
         raise TimeoutError("worker did not announce its task port in time")
+
+    def _poll_telemetry(self) -> None:
+        hub = self.driver.telemetry
+        interval_s = hub.interval_ms / 1000.0
+        while not self._telemetry_stop.wait(interval_s):
+            for w in list(self.workers):
+                try:
+                    payloads = w.request({"kind": "telemetry"}, timeout_s=10.0)
+                except Exception:
+                    logger.debug("telemetry poll of %s failed", w.executor_id,
+                                 exc_info=True)
+                    continue
+                for p in payloads or []:
+                    hub.ingest(p)
+            hub.check_missed()
 
     def _next_shuffle_id(self) -> int:
         with self._lock:
@@ -123,7 +150,14 @@ class ClusterContext:
             partitioner=partitioner or HashPartitioner(num_partitions),
         )
         self.driver.register_shuffle(handle)
+        try:
+            return self._run_map_reduce(handle, map_fns, num_partitions, reduce_fn)
+        except Exception as e:
+            if self.driver.telemetry is not None:
+                self.driver.telemetry.flight_record("job_failed", error=e)
+            raise
 
+    def _run_map_reduce(self, handle, map_fns, num_partitions, reduce_fn):
         # group this stage's tasks by worker and ship each group as ONE
         # map_batch request: one socket round trip per worker instead of
         # one per map, with the worker's bounded map pool (conf
@@ -167,6 +201,10 @@ class ClusterContext:
 
     # ------------------------------------------------------------------
     def stop(self) -> None:
+        self._telemetry_stop.set()
+        if self._telemetry_thread is not None:
+            self._telemetry_thread.join(timeout=5)
+            self._telemetry_thread = None
         for w in self.workers:
             try:
                 w.request({"kind": "stop"}, timeout_s=5.0)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -22,9 +23,12 @@ from sparkrdma_tpu.engine.rdd import (
     RDD,
     ShuffledRDD,
 )
+from sparkrdma_tpu.obs.metrics import get_registry
+from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.errors import ShuffleError
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.utils.config import TpuShuffleConf
 
 logger = logging.getLogger(__name__)
@@ -48,6 +52,21 @@ class TpuContext:
         self._rdd_counter = 0
         self._shuffle_counter = 0
         self._stopped = False
+        # in-process topology: heartbeats push straight into the driver
+        # hub (no control-plane hop); each executor samples its own
+        # role-filtered view of the shared process registry
+        self.heartbeaters: List[Heartbeater] = []
+        if self.driver.telemetry is not None:
+            for executor in self.executors:
+                self.heartbeaters.append(
+                    Heartbeater(
+                        get_registry(),
+                        executor.executor_id,
+                        interval_ms=self.conf.telemetry_interval_ms,
+                        send=self.driver.telemetry.ingest,
+                        match={"role": executor.executor_id},
+                    ).start()
+                )
 
     # ------------------------------------------------------------------
     def _next_rdd_id(self) -> int:
@@ -125,13 +144,22 @@ class TpuContext:
 
             def run_map(map_id: int) -> None:
                 executor = self.executor_for_partition(map_id)
-                writer = executor.get_writer(handle, map_id)
+                t0 = time.perf_counter()
+                plan = _faults.active()
+                if plan is not None:
+                    plan.on_stage("map_task", [], peer=executor.executor_id)
                 try:
-                    writer.write(parent.compute(map_id))
-                    writer.stop(True)
-                except Exception:
-                    writer.stop(False)
-                    raise
+                    writer = executor.get_writer(handle, map_id)
+                    try:
+                        writer.write(parent.compute(map_id))
+                        writer.stop(True)
+                    except Exception:
+                        writer.stop(False)
+                        raise
+                finally:
+                    get_registry().histogram(
+                        "engine.task_ms", role=executor.executor_id, kind="map"
+                    ).observe((time.perf_counter() - t0) * 1000.0)
 
             # dispatch each map through ITS executor's bounded map pool
             # (conf map.parallelism) — per-executor concurrency is the
@@ -181,10 +209,13 @@ class TpuContext:
                     return out
                 raise errors[0]
             except ShuffleError as e:
+                if self.driver.telemetry is not None:
+                    # post-mortem artifact BEFORE recompute mutates state
+                    self.driver.telemetry.flight_record(
+                        "fetch_failed", error=e
+                    )
                 if attempt == 1:
                     raise
-                from sparkrdma_tpu.obs import get_registry
-
                 get_registry().counter("engine.stage_recomputes").inc()
                 logger.warning("fetch failed (%s); recomputing stages", e)
                 # invalidate materialized shuffles below rdd and retry
@@ -200,8 +231,6 @@ class TpuContext:
         so ``registry`` is reported once at the top level (the per-role
         entries keep their role-filtered view from
         ``TpuShuffleManager.metrics_snapshot``)."""
-        from sparkrdma_tpu.obs import get_registry
-
         snap: Dict[str, dict] = {
             "driver": self.driver.metrics_snapshot(),
         }
@@ -209,6 +238,12 @@ class TpuContext:
             snap[executor.executor_id] = executor.metrics_snapshot()
         snap["registry"] = get_registry().snapshot()
         return snap
+
+    def telemetry_flush(self) -> None:
+        """Force one heartbeat from every executor NOW (tests/benches:
+        deterministic hub state without waiting out the interval)."""
+        for hb in self.heartbeaters:
+            hb.beat()
 
     def export_trace(self, path: str) -> dict:
         """Write the Chrome-trace JSON for every role's tracer."""
@@ -221,6 +256,8 @@ class TpuContext:
             return
         self._stopped = True
         self._pool.shutdown(wait=True)
+        for hb in self.heartbeaters:
+            hb.stop(flush=True)  # final delta lands in the hub
         for executor in self.executors:
             executor.stop()
         self.driver.stop()

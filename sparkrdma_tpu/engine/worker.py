@@ -29,11 +29,15 @@ import socket
 import struct
 import sys
 import threading
+import time
 import traceback
 
 import cloudpickle
 
+from sparkrdma_tpu.obs.metrics import get_registry
+from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.utils.config import TpuShuffleConf
 
 _LEN = struct.Struct(">I")
@@ -66,15 +70,34 @@ class Worker:
         self.manager = TpuShuffleManager(conf, is_driver=False, executor_id=executor_id)
         self.manager.start_node_if_missing()  # hello to driver now
         self._stop = threading.Event()
+        # outbox-mode heartbeater: samples role-filtered registry deltas
+        # on a timer; the driver pulls them with {"kind": "telemetry"}
+        self.heartbeater = None
+        if conf.telemetry_enabled:
+            self.heartbeater = Heartbeater(
+                get_registry(),
+                executor_id,
+                interval_ms=conf.telemetry_interval_ms,
+                match={"role": executor_id},
+            ).start()
 
     def _run_map(self, handle, map_id, records_fn) -> None:
-        writer = self.manager.get_writer(handle, map_id)
+        t0 = time.perf_counter()
+        plan = _faults.active()
+        if plan is not None:
+            plan.on_stage("map_task", [], peer=self.manager.executor_id)
         try:
-            writer.write(records_fn())
-            writer.stop(True)
-        except Exception:
-            writer.stop(False)
-            raise
+            writer = self.manager.get_writer(handle, map_id)
+            try:
+                writer.write(records_fn())
+                writer.stop(True)
+            except Exception:
+                writer.stop(False)
+                raise
+        finally:
+            get_registry().histogram(
+                "engine.task_ms", role=self.manager.executor_id, kind="map"
+            ).observe((time.perf_counter() - t0) * 1000.0)
 
     def handle(self, req):
         kind = req["kind"]
@@ -106,6 +129,10 @@ class Worker:
             return {"ok": True}
         if kind == "reduce":
             handle = req["handle"]
+            t0 = time.perf_counter()
+            plan = _faults.active()
+            if plan is not None:
+                plan.on_stage("reduce_task", [], peer=self.manager.executor_id)
             reader = self.manager.get_reader(handle, req["start"], req["end"])
             try:
                 it = reader.read()
@@ -115,7 +142,17 @@ class Worker:
                 # task-completion sweep: a reduce_fn that bails without
                 # consuming must not strand fetched streams until GC
                 reader.close()
+                get_registry().histogram(
+                    "engine.task_ms", role=self.manager.executor_id,
+                    kind="reduce",
+                ).observe((time.perf_counter() - t0) * 1000.0)
             return {"ok": True, "result": result}
+        if kind == "telemetry":
+            # control-plane pull: hand buffered heartbeats to the driver
+            payloads = (
+                self.heartbeater.drain() if self.heartbeater is not None else []
+            )
+            return {"ok": True, "result": payloads}
         if kind == "stop":
             self._stop.set()
             return {"ok": True}
@@ -156,6 +193,8 @@ class Worker:
                 break
             threading.Thread(target=one, args=(conn,), daemon=True).start()
         srv.close()
+        if self.heartbeater is not None:
+            self.heartbeater.stop(flush=False)  # nobody left to pull
         self.manager.stop()
 
 

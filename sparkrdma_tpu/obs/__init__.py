@@ -1,9 +1,18 @@
-"""Unified observability layer: metrics registry + shuffle tracing.
+"""Unified observability layer: metrics registry + shuffle tracing +
+the cluster telemetry plane.
 
-See docs/OBSERVABILITY.md for metric names, label conventions, and the
-Perfetto workflow. ``python -m sparkrdma_tpu.obs`` dumps the registry.
+See docs/OBSERVABILITY.md for metric names, label conventions, the
+Perfetto workflow, and the telemetry plane (heartbeats, time-series
+rings, straggler detection, OpenMetrics export, flight recorder).
+``python -m sparkrdma_tpu.obs`` dumps the registry.
 """
 
+from sparkrdma_tpu.obs.export import (
+    OpenMetricsServer,
+    extract_snapshot,
+    render_openmetrics,
+    write_openmetrics,
+)
 from sparkrdma_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -11,7 +20,12 @@ from sparkrdma_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
     metric_key,
+    parse_metric_key,
+    snapshot_delta,
+    strip_label,
 )
+from sparkrdma_tpu.obs.telemetry import Heartbeater, TelemetryHub
+from sparkrdma_tpu.obs.timeseries import TimeSeriesRing, Window
 from sparkrdma_tpu.obs.trace import (
     Span,
     Tracer,
@@ -27,17 +41,28 @@ from sparkrdma_tpu.obs.trace import (
 __all__ = [
     "Counter",
     "Gauge",
+    "Heartbeater",
     "Histogram",
     "MetricsRegistry",
+    "OpenMetricsServer",
     "Span",
+    "TelemetryHub",
+    "TimeSeriesRing",
     "Tracer",
+    "Window",
     "all_tracers",
     "collect_spans",
     "export_chrome_trace",
+    "extract_snapshot",
     "get_registry",
     "get_tracer",
     "metric_key",
     "mint_trace_id",
     "now",
+    "parse_metric_key",
+    "render_openmetrics",
+    "snapshot_delta",
+    "strip_label",
     "to_chrome_trace",
+    "write_openmetrics",
 ]

@@ -8,6 +8,13 @@ shuffle — driver + two executors over real TCP, wrapper writer method
 exports the span trace as Chrome trace-event JSON (open in Perfetto or
 chrome://tracing).
 
+Telemetry-plane egress: ``--openmetrics [DEST]`` renders the
+OpenMetrics text exposition instead of the JSON dump ('-' or no value
+= stdout), from the live registry or — with ``--from-snapshot FILE`` —
+from a registry snapshot saved inside a bench/workload artifact JSON.
+``--flight-recorder FILE`` pretty-prints a flight-record artifact
+(obs/telemetry.py) and exits.
+
 The demo is jax-free: it exercises the host shuffle planes (transport,
 rpc, writer, mempool, reader) only.
 """
@@ -15,9 +22,11 @@ rpc, writer, mempool, reader) only.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from sparkrdma_tpu.obs import export_chrome_trace, get_registry
+from sparkrdma_tpu.obs.export import extract_snapshot, render_openmetrics
 
 
 def _run_demo() -> None:
@@ -56,6 +65,42 @@ def _run_demo() -> None:
         driver.stop()
 
 
+def _print_flight(path: str) -> int:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("kind") != "sparkrdma_flight_record":
+        print(f"{path}: not a flight record (kind={doc.get('kind')!r})",
+              file=sys.stderr)
+        return 2
+    print(f"flight record v{doc.get('version')} — {doc.get('reason')} "
+          f"(role {doc.get('role')}, wall {doc.get('generated_wall_ms')} ms)")
+    err = doc.get("error")
+    if err:
+        print(f"  error: {err.get('type')}: {err.get('message')}")
+    failed = doc.get("failed_group")
+    if failed:
+        inner = ", ".join(f"{k}={v}" for k, v in sorted(failed.items()))
+        print(f"  failed group: {inner}")
+    stragglers = (doc.get("stragglers") or {}).get("stragglers") or []
+    if stragglers:
+        print(f"  stragglers: {', '.join(stragglers)}")
+    health = doc.get("source_health") or {}
+    for peer, state in sorted(health.items()):
+        print(f"  circuit[{peer}]: {state}")
+    execs = doc.get("executors") or {}
+    print(f"  executors: {len(execs)} "
+          f"(interval {doc.get('interval_ms')} ms)")
+    for eid in sorted(execs):
+        wins = execs[eid]
+        gaps = sum(1 for w in wins if w.get("gap"))
+        span = ""
+        if wins:
+            span = f", wall {wins[0]['wall_ms']}..{wins[-1]['wall_ms']}"
+        print(f"    {eid}: {len(wins)} windows, {gaps} gaps{span}")
+    print(f"  spans captured: {len(doc.get('spans') or [])}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sparkrdma_tpu.obs",
@@ -76,12 +121,43 @@ def main(argv=None) -> int:
         "(e.g. 'transport.')",
     )
     ap.add_argument("--indent", type=int, default=2)
+    ap.add_argument(
+        "--openmetrics", nargs="?", const="-", default=None, metavar="DEST",
+        help="render the OpenMetrics text exposition instead of the JSON "
+        "dump; DEST is a file path or '-' for stdout (default)",
+    )
+    ap.add_argument(
+        "--from-snapshot", default=None, metavar="FILE",
+        help="with --openmetrics: read the registry snapshot from a saved "
+        "JSON (raw snapshot, metrics_snapshot(), or bench artifact with "
+        "an 'obs_registry' key) instead of the live registry",
+    )
+    ap.add_argument(
+        "--flight-recorder", default=None, metavar="FILE",
+        help="pretty-print a flight-record JSON artifact and exit",
+    )
     args = ap.parse_args(argv)
 
+    if args.flight_recorder:
+        return _print_flight(args.flight_recorder)
     if args.demo:
         _run_demo()
     if args.trace_out:
         export_chrome_trace(args.trace_out)
+    if args.openmetrics is not None:
+        if args.from_snapshot:
+            with open(args.from_snapshot, "r", encoding="utf-8") as f:
+                snap = extract_snapshot(json.load(f))
+        else:
+            snap = get_registry().snapshot(prefix=args.prefix)
+        text = render_openmetrics(snap)
+        if args.openmetrics == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.openmetrics, "w", encoding="utf-8") as f:
+                f.write(text)
+            print(f"wrote {args.openmetrics}")
+        return 0
     print(get_registry().to_json(prefix=args.prefix, indent=args.indent))
     return 0
 

@@ -42,6 +42,71 @@ def metric_key(name: str, labels: Mapping[str, str]) -> str:
     return f"{name}{{{inner}}}"
 
 
+def parse_metric_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of :func:`metric_key`: ``name{k=v,...}`` -> (name, labels).
+
+    Label values are low-cardinality identifiers by convention (roles,
+    purposes, message types) and never contain ``,`` or ``}``."""
+    if not key.endswith("}"):
+        return key, {}
+    name, _, inner = key[:-1].partition("{")
+    labels: Dict[str, str] = {}
+    for kv in inner.split(","):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        labels[k] = v
+    return name, labels
+
+
+def strip_label(key: str, *label_keys: str) -> str:
+    """Canonical key with the given label keys removed (cross-executor
+    comparison: drop ``role``/``executor`` so the same instrument on two
+    executors folds to one comparable key)."""
+    name, labels = parse_metric_key(key)
+    for k in label_keys:
+        labels.pop(k, None)
+    return metric_key(name, labels)
+
+
+def snapshot_delta(
+    prev: Mapping[str, Mapping[str, object]],
+    cur: Mapping[str, Mapping[str, object]],
+) -> Dict[str, Dict[str, object]]:
+    """Reset-safe diff of two ``snapshot()`` dicts.
+
+    Counters and histogram count/sum are differenced; gauges report
+    their current state. A *negative* difference means the instrument
+    was zeroed (``reset()``) after ``prev`` was taken — the Prometheus
+    counter-reset rule applies: the delta restarts from the current
+    value instead of going negative, so a long-lived consumer holding a
+    moving baseline (the telemetry Heartbeater) never resurrects
+    pre-reset totals."""
+    prev_c = prev.get("counters", {})
+    prev_h = prev.get("histograms", {})
+    out: Dict[str, Dict[str, object]] = {
+        "counters": {},
+        "gauges": dict(cur.get("gauges", {})),
+        "histograms": {},
+    }
+    for key, v in cur.get("counters", {}).items():
+        d = v - prev_c.get(key, 0)
+        out["counters"][key] = v if d < 0 else d
+    for key, h in cur.get("histograms", {}).items():
+        ph = prev_h.get(key, {})
+        dc = h["count"] - ph.get("count", 0)
+        ds = h["sum"] - ph.get("sum", 0.0)
+        if dc < 0 or ds < 0:
+            dc, ds = h["count"], h["sum"]
+        out["histograms"][key] = {
+            "count": dc,
+            "sum": ds,
+            "min": h["min"],
+            "max": h["max"],
+        }
+    return out
+
+
 class Counter:
     """Monotonic counter. ``inc`` is the only mutator."""
 
@@ -220,22 +285,9 @@ class MetricsRegistry:
               match: Optional[Mapping[str, str]] = None,
               prefix: Optional[str] = None) -> Dict[str, Dict[str, object]]:
         """Change since a prior ``snapshot()``: counters and histogram
-        count/sum are differenced; gauges report their current state."""
-        cur = self.snapshot(match, prefix)
-        prev_c = prev.get("counters", {})
-        prev_h = prev.get("histograms", {})
-        out = {"counters": {}, "gauges": cur["gauges"], "histograms": {}}
-        for key, v in cur["counters"].items():
-            out["counters"][key] = v - prev_c.get(key, 0)
-        for key, h in cur["histograms"].items():
-            ph = prev_h.get(key, {})
-            out["histograms"][key] = {
-                "count": h["count"] - ph.get("count", 0),
-                "sum": h["sum"] - ph.get("sum", 0.0),
-                "min": h["min"],
-                "max": h["max"],
-            }
-        return out
+        count/sum are differenced (reset-safe, see
+        :func:`snapshot_delta`); gauges report their current state."""
+        return snapshot_delta(prev, self.snapshot(match, prefix))
 
     def to_json(self, match: Optional[Mapping[str, str]] = None,
                 prefix: Optional[str] = None, indent: Optional[int] = None

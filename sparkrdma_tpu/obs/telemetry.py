@@ -1,0 +1,612 @@
+"""Cluster telemetry plane: executor heartbeats -> driver time-series.
+
+PR 1 gave every *process* a registry; nothing ever crossed the wire, so
+the driver could not see a slow executor while a job ran. This module
+is the Dapper-style move of centralizing cross-role signal, applied to
+metrics:
+
+- :class:`Heartbeater` runs on each executor: every
+  ``obs.telemetry.intervalMs`` it takes a role-filtered
+  ``MetricsRegistry`` snapshot, diffs it against a *moving baseline*
+  (reset-safe, :func:`~sparkrdma_tpu.obs.metrics.snapshot_delta`), and
+  ships the labeled delta + in-flight gauge samples either directly
+  (in-process clusters: ``send=hub.ingest``) or into a bounded outbox
+  the driver pulls over the engine control plane (the ``"telemetry"``
+  task-protocol kind in ``engine/worker.py`` / ``engine/cluster.py``).
+- :class:`TelemetryHub` runs on the driver: heartbeats fold into
+  bounded per-executor :class:`~sparkrdma_tpu.obs.timeseries.TimeSeriesRing`
+  buffers (wall-bucketed at the heartbeat interval, capped by
+  ``obs.telemetry.ringSize``), an online straggler/skew detector runs a
+  per-stage robust z-score over ``writer.pipeline.*`` /
+  ``reader.pipeline.*`` / ``engine.task_ms`` busy-ms and
+  ``transport.read_bytes`` / ``writer.bytes_written`` work rates, and
+  two egress paths serve the result: an OpenMetrics exposition
+  (``obs/export.py``, HTTP scrape on ``obs.telemetry.httpPort`` or a
+  file) and a flight recorder that dumps the last N ring windows +
+  recent spans + circuit-breaker states to one JSON artifact on
+  ``FetchFailedError``/abort.
+
+Flagged executors surface as ``telemetry.straggler{executor=...}``
+gauges and a structured :meth:`TelemetryHub.straggler_report`, which
+``SourceHealthRegistry.apply_straggler_report`` consumes as an
+*advisory* signal (suspects are recorded, circuits are not opened —
+docs/RESILIENCE.md).
+
+Stdlib-only and jax-free, like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import statistics
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Mapping, Optional
+
+from sparkrdma_tpu.obs.metrics import (
+    MetricsRegistry,
+    get_registry,
+    parse_metric_key,
+    snapshot_delta,
+    strip_label,
+)
+from sparkrdma_tpu.obs.timeseries import TimeSeriesRing
+
+logger = logging.getLogger(__name__)
+
+# Metric families the straggler detector reads. Busy families are
+# time-spent signals (histogram sums / counters in ms): a straggler is
+# an abnormally HIGH outlier. Work families are throughput signals
+# (byte counters): a straggler is an abnormally LOW outlier.
+BUSY_PREFIXES = ("writer.pipeline.stage_ms", "reader.pipeline.stage_ms",
+                 "engine.task_ms")
+WORK_PREFIXES = ("transport.read_bytes", "writer.bytes_written")
+
+# Detection guards: a stage is only scored when at least MIN_PARTICIPANTS
+# executors report nonzero activity on it (an executor that simply was
+# not scheduled any reduce range is not a straggler), a busy flag needs
+# a real absolute excess over the median, and a work flag needs the
+# value to fall below half the median of a non-trivial workload.
+MIN_PARTICIPANTS = 3
+MIN_BUSY_EXCESS_MS = 50.0
+MIN_WORK_MEDIAN_BYTES = 1 << 16
+# MAD == 0 fallback: treat 15% of the median as one deviation unit so
+# identical-but-for-jitter executors don't divide by zero into flags.
+MAD_FALLBACK_FRACTION = 0.15
+# A heartbeat is "missed" once nothing arrived for this many intervals.
+MISSED_AFTER_INTERVALS = 2.5
+
+
+def _robust_z(value: float, values: List[float]) -> float:
+    """Robust z-score of ``value`` within ``values`` (median/MAD)."""
+    med = statistics.median(values)
+    mad = statistics.median(abs(v - med) for v in values)
+    scale = 1.4826 * mad
+    if scale <= 0.0:
+        scale = max(MAD_FALLBACK_FRACTION * abs(med), 1e-9)
+    return (value - med) / scale
+
+
+class Heartbeater:
+    """Executor-side heartbeat loop over a moving registry baseline.
+
+    Each :meth:`beat` produces one payload::
+
+        {"v": 1, "executor_id": ..., "seq": n, "wall_ms": ...,
+         "interval_ms": ..., "counters": {key: delta != 0},
+         "gauges": {key: {"value", "hwm"}},
+         "histograms": {key: {"count": dc, "sum": ds}} (dc != 0)}
+
+    With ``send`` the payload ships immediately (in-process hub);
+    without, it lands in a bounded outbox the driver drains via the
+    ``"telemetry"`` control-plane request (``seq`` keeps counting when
+    the outbox overflows, so the hub sees the gap). ``pause()`` /
+    ``resume()`` simulate a lost executor without stopping the thread.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        executor_id: str,
+        interval_ms: int = 1000,
+        send: Optional[Callable[[dict], None]] = None,
+        match: Optional[Mapping[str, str]] = None,
+        outbox_size: int = 256,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._registry = registry
+        self.executor_id = executor_id
+        self.interval_ms = max(1, int(interval_ms))
+        self._send = send
+        self._match = dict(match) if match else None
+        self._clock = clock
+        self._outbox: "deque[dict]" = deque(maxlen=max(1, outbox_size))
+        self._lock = threading.Lock()
+        self._prev = registry.snapshot(self._match)
+        self._seq = 0
+        self._paused = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> Optional[dict]:
+        """One sample: delta vs the moving baseline, then advance it."""
+        with self._lock:
+            if self._paused:
+                return None
+            cur = self._registry.snapshot(self._match)
+            delta = snapshot_delta(self._prev, cur)
+            self._prev = cur
+            self._seq += 1
+            seq = self._seq
+        payload = {
+            "v": 1,
+            "executor_id": self.executor_id,
+            "seq": seq,
+            "wall_ms": int(self._clock() * 1000),
+            "interval_ms": self.interval_ms,
+            "counters": {k: v for k, v in delta["counters"].items() if v},
+            "gauges": {
+                k: g for k, g in delta["gauges"].items()
+                if g.get("value") or g.get("hwm")
+            },
+            "histograms": {
+                k: {"count": h["count"], "sum": h["sum"]}
+                for k, h in delta["histograms"].items()
+                if h["count"]
+            },
+        }
+        if self._send is not None:
+            try:
+                self._send(payload)
+            except Exception:
+                logger.debug("heartbeat send failed", exc_info=True)
+        else:
+            self._outbox.append(payload)
+        return payload
+
+    def drain(self) -> List[dict]:
+        """Pull-side: hand over (and clear) the buffered payloads."""
+        out: List[dict] = []
+        while True:
+            try:
+                out.append(self._outbox.popleft())
+            except IndexError:
+                return out
+
+    def pause(self) -> None:
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
+    def start(self) -> "Heartbeater":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"heartbeat-{self.executor_id}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_ms / 1000.0):
+            try:
+                self.beat()
+            except Exception:
+                logger.exception("heartbeat loop error")
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if flush:
+            self.beat()
+
+
+class TelemetryHub:
+    """Driver-side fold of executor heartbeats into bounded time series.
+
+    Passive unless fed: :meth:`ingest` does all online work (ring fold,
+    gap accounting, straggler detection, optional OpenMetrics file
+    write), so the hub adds no threads of its own beyond the optional
+    HTTP scrape server.
+    """
+
+    _flight_seq = 0
+
+    def __init__(
+        self,
+        conf=None,
+        *,
+        role: str = "driver",
+        health=None,
+        registry: Optional[MetricsRegistry] = None,
+        interval_ms: Optional[int] = None,
+        ring_size: Optional[int] = None,
+        straggler_z: Optional[float] = None,
+        http_port: Optional[int] = None,
+        openmetrics_file: Optional[str] = None,
+        flight_dir: Optional[str] = None,
+        flight_windows: Optional[int] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.role = role
+        self._health = health
+        self._registry = registry or get_registry()
+        self._clock = clock
+        self.interval_ms = int(
+            interval_ms
+            if interval_ms is not None
+            else (conf.telemetry_interval_ms if conf is not None else 1000)
+        )
+        self.ring_size = int(
+            ring_size
+            if ring_size is not None
+            else (conf.telemetry_ring_size if conf is not None else 128)
+        )
+        self.straggler_z = float(
+            straggler_z
+            if straggler_z is not None
+            else (conf.telemetry_straggler_z if conf is not None else 3)
+        )
+        self._http_port = int(
+            http_port
+            if http_port is not None
+            else (conf.telemetry_http_port if conf is not None else 0)
+        )
+        self._openmetrics_file = (
+            openmetrics_file
+            if openmetrics_file is not None
+            else (conf.telemetry_openmetrics_file if conf is not None else "")
+        )
+        self._flight_dir = (
+            flight_dir
+            if flight_dir is not None
+            else (conf.telemetry_flight_dir if conf is not None else "")
+        )
+        self.flight_windows = int(
+            flight_windows
+            if flight_windows is not None
+            else (conf.telemetry_flight_windows if conf is not None else 16)
+        )
+
+        self._lock = threading.Lock()
+        self._series: Dict[str, TimeSeriesRing] = {}
+        # per-executor missed-heartbeat accounting: True once the gap
+        # was counted; cleared (and surfaced as a ring gap marker) when
+        # the executor resumes
+        self._missed_counted: Dict[str, bool] = {}
+        self._last_report: dict = {"stragglers": []}
+        self._last_file_write_ms = 0
+        self.last_flight_path: Optional[str] = None
+        self.last_flight: Optional[dict] = None
+
+        reg = self._registry
+        self._g_executors = reg.gauge("telemetry.executors", role=role)
+        self._g_missed = reg.gauge("telemetry.missed_heartbeats", role=role)
+        self._g_stragglers = reg.gauge("telemetry.stragglers", role=role)
+        self._c_bad = reg.counter("telemetry.bad_payloads", role=role)
+
+        self._http = None
+        if self._http_port > 0:
+            from sparkrdma_tpu.obs.export import OpenMetricsServer
+
+            self._http = OpenMetricsServer(
+                self.render_openmetrics, port=self._http_port
+            )
+
+    # -- ingest --------------------------------------------------------
+    def ingest(self, payload: Mapping) -> None:
+        """Fold one heartbeat payload into its executor's ring."""
+        try:
+            exec_id = str(payload["executor_id"])
+            wall_ms = int(payload["wall_ms"])
+            seq = int(payload.get("seq", 0))
+        except (KeyError, TypeError, ValueError):
+            self._c_bad.inc()
+            return
+        with self._lock:
+            ring = self._series.get(exec_id)
+            if ring is None:
+                ring = TimeSeriesRing(self.ring_size, self.interval_ms)
+                self._series[exec_id] = ring
+            gap = False
+            if ring.last_seq and seq > ring.last_seq + 1:
+                gap = True
+                self._g_missed.add(seq - ring.last_seq - 1)
+            if self._missed_counted.pop(exec_id, False):
+                gap = True  # resumed after a wall-clock gap
+            self._g_executors.set(len(self._series))
+        ring.append(
+            wall_ms,
+            seq,
+            counters=payload.get("counters"),
+            gauges=payload.get("gauges"),
+            histograms=payload.get("histograms"),
+            gap=gap,
+        )
+        self._registry.counter(
+            "telemetry.heartbeats", role=self.role, executor=exec_id
+        ).inc()
+        self.check_missed(now_ms=wall_ms)
+        self._update_stragglers()
+        self._maybe_write_file(wall_ms)
+
+    def check_missed(self, now_ms: Optional[int] = None) -> List[str]:
+        """Flag executors whose last heartbeat is stale; returns the
+        newly-flagged ids. A gap is counted ONCE per outage (gauge
+        ``telemetry.missed_heartbeats``); the executor's next heartbeat
+        re-arms the check and marks the gap in its ring."""
+        if now_ms is None:
+            now_ms = int(self._clock() * 1000)
+        stale_after = MISSED_AFTER_INTERVALS * self.interval_ms
+        newly: List[str] = []
+        with self._lock:
+            for exec_id, ring in self._series.items():
+                if self._missed_counted.get(exec_id):
+                    continue
+                if ring.last_wall_ms and now_ms - ring.last_wall_ms > stale_after:
+                    self._missed_counted[exec_id] = True
+                    self._g_missed.add(1)
+                    newly.append(exec_id)
+        for exec_id in newly:
+            logger.warning(
+                "telemetry: no heartbeat from %s for > %.0f ms",
+                exec_id, stale_after,
+            )
+        return newly
+
+    # -- read side -----------------------------------------------------
+    def executors(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series(self, executor_id: str) -> Optional[TimeSeriesRing]:
+        with self._lock:
+            return self._series.get(executor_id)
+
+    def timeline(self, last: Optional[int] = None) -> Dict[str, List[dict]]:
+        """JSON-able per-executor window lists (bench artifacts)."""
+        with self._lock:
+            items = list(self._series.items())
+        return {eid: ring.to_list(last) for eid, ring in items}
+
+    def rollups(self, last: Optional[int] = None) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._series.items())
+        return {eid: ring.rollup(last) for eid, ring in items}
+
+    def summary(self) -> dict:
+        """Compact hub view for ``metrics_snapshot()`` on the driver."""
+        with self._lock:
+            execs = {
+                eid: {
+                    "windows": len(ring),
+                    "last_wall_ms": ring.last_wall_ms,
+                    "last_seq": ring.last_seq,
+                    "missed": bool(self._missed_counted.get(eid)),
+                }
+                for eid, ring in self._series.items()
+            }
+        return {
+            "interval_ms": self.interval_ms,
+            "ring_size": self.ring_size,
+            "executors": execs,
+            "stragglers": list(self._last_report.get("stragglers", [])),
+            "missed_heartbeats": self._g_missed.value,
+        }
+
+    # -- straggler / skew detection ------------------------------------
+    def straggler_report(self) -> dict:
+        """Online per-stage robust z-score over busy-ms and work rates.
+
+        Keys are normalized (``role``/``executor`` labels stripped) so
+        the same instrument on two executors compares directly. A stage
+        is scored only when >= ``MIN_PARTICIPANTS`` executors report
+        nonzero activity on it; an executor is a straggler when any
+        busy stage scores ``> straggler_z`` with a real absolute excess,
+        or any work family scores ``< -straggler_z`` at under half the
+        median of a non-trivial workload."""
+        rollups = self.rollups()
+        busy_by_stage: Dict[str, Dict[str, float]] = {}
+        work_by_family: Dict[str, Dict[str, float]] = {}
+        for eid, roll in rollups.items():
+            for key, h in roll["histograms"].items():
+                name, _ = parse_metric_key(key)
+                if name.startswith(BUSY_PREFIXES):
+                    norm = strip_label(key, "role", "executor")
+                    busy_by_stage.setdefault(norm, {})[eid] = (
+                        busy_by_stage.get(norm, {}).get(eid, 0.0)
+                        + float(h.get("sum", 0.0))
+                    )
+            for key, v in roll["counters"].items():
+                name, _ = parse_metric_key(key)
+                if name.startswith(BUSY_PREFIXES):
+                    norm = strip_label(key, "role", "executor")
+                    busy_by_stage.setdefault(norm, {})[eid] = (
+                        busy_by_stage.get(norm, {}).get(eid, 0.0) + float(v)
+                    )
+                elif name.startswith(WORK_PREFIXES):
+                    norm = strip_label(key, "role", "executor")
+                    work_by_family.setdefault(norm, {})[eid] = (
+                        work_by_family.get(norm, {}).get(eid, 0.0) + float(v)
+                    )
+
+        details: Dict[str, dict] = {
+            eid: {"busy_ms": 0.0, "work_bytes": 0.0, "flags": []}
+            for eid in rollups
+        }
+        stragglers: set = set()
+        for stage, per_exec in busy_by_stage.items():
+            for eid, v in per_exec.items():
+                details[eid]["busy_ms"] += v
+            participants = {e: v for e, v in per_exec.items() if v > 0}
+            if len(participants) < MIN_PARTICIPANTS:
+                continue
+            values = list(participants.values())
+            med = statistics.median(values)
+            for eid, v in participants.items():
+                z = _robust_z(v, values)
+                if z > self.straggler_z and (v - med) > MIN_BUSY_EXCESS_MS:
+                    stragglers.add(eid)
+                    details[eid]["flags"].append({
+                        "kind": "busy", "stage": stage,
+                        "z": round(z, 2), "value_ms": round(v, 3),
+                        "median_ms": round(med, 3),
+                    })
+        for family, per_exec in work_by_family.items():
+            for eid, v in per_exec.items():
+                details[eid]["work_bytes"] += v
+            participants = {e: v for e, v in per_exec.items() if v > 0}
+            if len(participants) < MIN_PARTICIPANTS:
+                continue
+            values = list(participants.values())
+            med = statistics.median(values)
+            if med < MIN_WORK_MEDIAN_BYTES:
+                continue
+            for eid, v in participants.items():
+                z = _robust_z(v, values)
+                if z < -self.straggler_z and v < med / 2:
+                    stragglers.add(eid)
+                    details[eid]["flags"].append({
+                        "kind": "work", "family": family,
+                        "z": round(z, 2), "value_bytes": int(v),
+                        "median_bytes": int(med),
+                    })
+        report = {
+            "generated_wall_ms": int(self._clock() * 1000),
+            "threshold_z": self.straggler_z,
+            "executors": details,
+            "stragglers": sorted(stragglers),
+        }
+        return report
+
+    def _update_stragglers(self) -> None:
+        report = self.straggler_report()
+        flagged = set(report["stragglers"])
+        known = set(report["executors"])
+        self._g_stragglers.set(len(flagged))
+        for eid in known:
+            self._registry.gauge(
+                "telemetry.straggler", role=self.role, executor=eid
+            ).set(1 if eid in flagged else 0)
+        self._last_report = report
+        if self._health is not None:
+            try:
+                self._health.apply_straggler_report(report)
+            except Exception:
+                logger.exception("straggler advisory failed")
+
+    # -- egress: OpenMetrics -------------------------------------------
+    def render_openmetrics(self) -> str:
+        from sparkrdma_tpu.obs.export import render_openmetrics
+
+        return render_openmetrics(self._registry.snapshot())
+
+    def _maybe_write_file(self, now_ms: int) -> None:
+        if not self._openmetrics_file:
+            return
+        if now_ms - self._last_file_write_ms < self.interval_ms:
+            return
+        self._last_file_write_ms = now_ms
+        try:
+            from sparkrdma_tpu.obs.export import write_openmetrics
+
+            write_openmetrics(self._openmetrics_file,
+                              self._registry.snapshot())
+        except OSError:
+            logger.warning("openmetrics file write failed",
+                           exc_info=True)
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._http.port if self._http is not None else None
+
+    # -- egress: flight recorder ---------------------------------------
+    def flight_record(self, reason: str, error: Optional[BaseException] = None,
+                      path: Optional[str] = None) -> Optional[str]:
+        """Dump the post-mortem artifact: last N ring windows per
+        executor + recent spans + circuit-breaker states + the failed
+        group (from the error's ``shuffle_id``/``partition_id``/
+        ``manager_id`` attributes when present). Best-effort: returns
+        the written path, or None — never a new failure mode."""
+        doc: dict = {
+            "kind": "sparkrdma_flight_record",
+            "version": 1,
+            "generated_wall_ms": int(self._clock() * 1000),
+            "role": self.role,
+            "reason": reason,
+            "interval_ms": self.interval_ms,
+            "executors": self.timeline(last=self.flight_windows),
+            "stragglers": self._last_report,
+            "source_health": (
+                self._health.states() if self._health is not None else {}
+            ),
+        }
+        if error is not None:
+            doc["error"] = {
+                "type": type(error).__name__,
+                "message": str(error),
+            }
+            failed = {}
+            for attr in ("shuffle_id", "map_id", "partition_id"):
+                v = getattr(error, attr, None)
+                if v is not None:
+                    failed[attr] = v
+            mid = getattr(error, "manager_id", None)
+            if mid is not None:
+                failed["source"] = str(mid)
+            if failed:
+                doc["failed_group"] = failed
+        try:
+            from sparkrdma_tpu.obs.trace import collect_spans
+
+            doc["spans"] = [
+                {
+                    "name": sp.name,
+                    "role": sp.role,
+                    "trace_id": f"{sp.trace_id:#x}" if sp.trace_id else None,
+                    "start": sp.start,
+                    "end": sp.end,
+                    "args": dict(sp.args),
+                }
+                for sp in collect_spans()[-200:]
+            ]
+        except Exception:
+            doc["spans"] = []
+        if path is None:
+            base = self._flight_dir or tempfile.gettempdir()
+            TelemetryHub._flight_seq += 1
+            path = os.path.join(
+                base,
+                f"sparkrdma-flight-{os.getpid()}-{TelemetryHub._flight_seq}.json",
+            )
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, default=str)
+        except OSError:
+            logger.warning("flight record write to %s failed", path,
+                           exc_info=True)
+            path = None
+        else:
+            logger.warning("flight record written: %s (%s)", path, reason)
+        self.last_flight = doc
+        self.last_flight_path = path
+        return path
+
+    def stop(self) -> None:
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+        if self._openmetrics_file:
+            # final exposition so scrape-less runs keep the end state
+            self._last_file_write_ms = 0
+            self._maybe_write_file(int(self._clock() * 1000))

@@ -128,9 +128,13 @@ class SourceHealthRegistry:
         self._role = role
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
+        self._suspects: Dict[str, dict] = {}
         reg = get_registry()
         self._m_open = reg.counter("resilience.circuit_open", role=role)
         self._m_close = reg.counter("resilience.circuit_close", role=role)
+        self._m_advisory = reg.counter(
+            "resilience.straggler_advisories", role=role
+        )
 
     def get(self, executor_id: str) -> CircuitBreaker:
         with self._lock:
@@ -160,3 +164,35 @@ class SourceHealthRegistry:
         with self._lock:
             items = list(self._breakers.items())
         return {peer: br.state for peer, br in items}
+
+    # -- telemetry advisory path (docs/RESILIENCE.md) ---------------------
+    def apply_straggler_report(self, report: Dict) -> None:
+        """Advisory signal from the telemetry hub's straggler detector.
+
+        A straggler is SLOW, not DEAD: the report marks the executor as
+        a suspect (visible in :meth:`suspects` and counted under
+        ``resilience.straggler_advisories``) but never opens its
+        circuit — only the breaker's own consecutive fetch failures do
+        that. Suspects that fall out of the report are cleared.
+        """
+        flagged = set(report.get("stragglers") or ())
+        wall_ms = report.get("generated_wall_ms", 0)
+        with self._lock:
+            new = flagged - set(self._suspects)
+            self._suspects = {
+                eid: self._suspects.get(eid, {"first_wall_ms": wall_ms})
+                for eid in flagged
+            }
+            for eid in flagged:
+                self._suspects[eid]["last_wall_ms"] = wall_ms
+        for eid in sorted(new):
+            self._m_advisory.inc()
+            logger.warning(
+                "telemetry advisory: %s flagged as straggler (circuit NOT "
+                "opened; advisory only)", eid,
+            )
+
+    def suspects(self) -> Dict[str, dict]:
+        """Executors currently flagged by the straggler advisory."""
+        with self._lock:
+            return {eid: dict(info) for eid, info in self._suspects.items()}

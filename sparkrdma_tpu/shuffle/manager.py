@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
+from sparkrdma_tpu.obs.telemetry import TelemetryHub
 from sparkrdma_tpu.resilience import SourceHealthRegistry
 from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.utils import checksum as _checksum
@@ -119,6 +120,17 @@ class TpuShuffleManager:
         # the conf-driven fault plan for reproducible chaos runs
         self.health = SourceHealthRegistry(conf, role=self.executor_id)
         _faults.ensure_installed(conf.fault_plan, conf.fault_plan_seed)
+
+        # cluster telemetry plane: the driver (already the metadata hub
+        # for every shuffle) folds executor heartbeats into per-executor
+        # time series and runs the straggler detector; its report feeds
+        # the health registry as an advisory signal (obs/telemetry.py)
+        self.telemetry = None
+        if is_driver and conf.telemetry_enabled:
+            self.telemetry = TelemetryHub(
+                conf, role=self.executor_id, health=self.health,
+                registry=self.registry,
+            )
 
         if is_driver:
             # driver starts its node eagerly and records the negotiated
@@ -608,6 +620,8 @@ class TpuShuffleManager:
         snap["shuffle_read"] = agg
         # circuit-breaker states per tracked remote peer (resilience)
         snap["source_health"] = self.health.states()
+        if self.telemetry is not None:
+            snap["telemetry"] = self.telemetry.summary()
         # the unified registry view: every instrument whose labels are
         # compatible with this manager's role (process-global metrics
         # without a role label are included)
@@ -622,6 +636,8 @@ class TpuShuffleManager:
             map_pool, self._map_pool = self._map_pool, None
         if map_pool is not None:
             map_pool.shutdown(wait=True)
+        if self.telemetry is not None:
+            self.telemetry.stop()
         if self.reader_stats is not None:
             self.reader_stats.print_stats()
         self.resolver.stop()

@@ -247,15 +247,21 @@ class FaultPlan:
         self._flip_byte(mutated, fire_index)
         return bytes(mutated), False
 
-    def on_stage(self, stage: str, views) -> None:
+    def on_stage(self, stage: str, views, peer: str = "") -> None:
         """Reduce-pipeline seam (DeviceShuffleIO decode/staging): fired
         with the block's host views AFTER transport delivered them
         intact. ``corrupt`` flips one byte in place — the adversary the
         decode-stage checksum gate exists for; ``fail``/``drop`` raise
         :class:`InjectedFault` (a failed decode); ``delay`` stalls the
         stage body. Read-only views (mapped page-cache windows) can't
-        be corrupted honestly, so ``corrupt`` degrades to a raise."""
-        hit = self._match("stage", "", stage=stage)
+        be corrupted honestly, so ``corrupt`` degrades to a raise.
+
+        The engine task seams also fire here (stages ``map_task`` /
+        ``reduce_task``, empty ``views``) passing the owning executor
+        id as ``peer`` — ``stage:delay:0:delay_ms=...:stage=map_task,
+        peer=exec-1`` slows exactly one executor, the skew injector the
+        telemetry straggler tests use."""
+        hit = self._match("stage", peer, stage=stage)
         if hit is None:
             return
         rule, fire_index = hit

@@ -185,6 +185,48 @@ class TpuShuffleConf:
         """Bound on retained spans per tracer (oldest evicted first)."""
         return self._int("obs.traceMaxSpans", 20000, 100, 1 << 24)
 
+    # -- cluster telemetry plane (obs/telemetry.py) -----------------------
+    @property
+    def telemetry_enabled(self) -> bool:
+        """Run the executor heartbeat loops + driver TelemetryHub."""
+        return self._bool("obs.telemetry.enabled", True)
+
+    @property
+    def telemetry_interval_ms(self) -> int:
+        """Heartbeat period; also the hub's ring-buffer wall-bucket width."""
+        return self._int("obs.telemetry.intervalMs", 1000, 10, 600000)
+
+    @property
+    def telemetry_ring_size(self) -> int:
+        """Windows retained per executor on the driver (bounded memory)."""
+        return self._int("obs.telemetry.ringSize", 128, 8, 65536)
+
+    @property
+    def telemetry_http_port(self) -> int:
+        """OpenMetrics scrape port on the driver; 0 disables the server."""
+        return self._int("obs.telemetry.httpPort", 0, 0, 65535)
+
+    @property
+    def telemetry_straggler_z(self) -> float:
+        """Robust z-score threshold for the straggler/skew detector."""
+        return float(self._int("obs.telemetry.stragglerZ", 3, 1, 1000))
+
+    @property
+    def telemetry_flight_windows(self) -> int:
+        """Ring windows per executor dumped into a flight record."""
+        return self._int("obs.telemetry.flightWindows", 16, 1, 65536)
+
+    @property
+    def telemetry_flight_dir(self) -> str:
+        """Directory for flight-record JSONs; "" = system temp dir."""
+        return str(self.get(PREFIX + "obs.telemetry.flightDir", "") or "")
+
+    @property
+    def telemetry_openmetrics_file(self) -> str:
+        """If set, the hub rewrites this file with the OpenMetrics
+        exposition once per interval (scrape-less egress)."""
+        return str(self.get(PREFIX + "obs.telemetry.openmetricsFile", "") or "")
+
     # -- endpoints / connection management (RdmaShuffleConf.scala:118-126)
     @property
     def driver_host(self) -> str:

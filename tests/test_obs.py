@@ -94,6 +94,31 @@ def test_delta_diffs_counters_and_histograms():
     assert d["histograms"]["d.ms"]["sum"] == pytest.approx(4.0)
 
 
+def test_delta_after_reset_does_not_resurrect_totals():
+    """Registry lifecycle for long-lived hubs (ISSUE 5 satellite): a
+    moving-baseline delta taken across a reset() must apply the
+    counter-reset rule — restart from the current value — instead of
+    going negative or replaying pre-reset totals."""
+    reg = MetricsRegistry()
+    c = reg.counter("r.n")
+    h = reg.histogram("r.ms", bounds=(10,))
+    c.inc(5)
+    h.observe(3)
+    h.observe(7)
+    prev = reg.snapshot()  # moving baseline: 5 / count 2
+    reg.reset()
+    c.inc(2)
+    h.observe(1)
+    d = reg.delta(prev)
+    assert d["counters"]["r.n"] == 2
+    assert d["histograms"]["r.ms"]["count"] == 1
+    assert d["histograms"]["r.ms"]["sum"] == pytest.approx(1.0)
+    # and the next interval, with the baseline advanced, diffs normally
+    prev = reg.snapshot()
+    c.inc(3)
+    assert reg.delta(prev)["counters"]["r.n"] == 3
+
+
 def test_registry_concurrent_get_or_create_and_inc():
     reg = MetricsRegistry()
     n_threads, per_thread = 8, 500

@@ -1,0 +1,488 @@
+"""Cluster telemetry plane: ring-buffer semantics, heartbeat delivery
+(push and pull), straggler detection under injected one-executor skew,
+missed-heartbeat tolerance, OpenMetrics exposition format, and the
+flight recorder — ISSUE 5's tentpole acceptance tests."""
+
+import json
+import re
+import time
+import urllib.request
+
+import pytest
+
+from sparkrdma_tpu.obs import (
+    Heartbeater,
+    MetricsRegistry,
+    OpenMetricsServer,
+    TelemetryHub,
+    TimeSeriesRing,
+    extract_snapshot,
+    render_openmetrics,
+)
+from sparkrdma_tpu.testing import faults
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+# ---------------------------------------------------------------------------
+# time-series ring units
+# ---------------------------------------------------------------------------
+
+def test_ring_same_bucket_merges_deltas_and_refreshes_gauges():
+    ring = TimeSeriesRing(size=8, interval_ms=100)
+    ring.append(1000, 1, counters={"c": 5}, gauges={"g": {"value": 1, "hwm": 1}},
+                histograms={"h": {"count": 1, "sum": 2.0}})
+    ring.append(1050, 2, counters={"c": 3}, gauges={"g": {"value": 9, "hwm": 9}},
+                histograms={"h": {"count": 2, "sum": 4.0}})
+    assert len(ring) == 1  # same wall bucket (1000//100 == 1050//100)
+    w = ring.windows()[0]
+    assert w.counters["c"] == 8
+    assert w.gauges["g"]["value"] == 9  # latest sample wins
+    assert w.histograms["h"] == {"count": 3, "sum": 6.0}
+    assert w.seq == 2 and w.wall_ms == 1050
+    ring.append(1100, 3, counters={"c": 1})
+    assert len(ring) == 2  # next bucket
+
+
+def test_ring_is_bounded_and_rollup_sums_retained_windows():
+    ring = TimeSeriesRing(size=4, interval_ms=10)
+    for i in range(10):
+        ring.append(i * 10, i + 1, counters={"c": 1})
+    assert len(ring) == 4  # oldest evicted
+    assert ring.rollup()["counters"]["c"] == 4
+    assert ring.rollup(last=2)["counters"]["c"] == 2
+    assert [w["seq"] for w in ring.to_list(last=2)] == [9, 10]
+
+
+# ---------------------------------------------------------------------------
+# heartbeater units
+# ---------------------------------------------------------------------------
+
+def test_heartbeater_emits_interval_deltas_against_moving_baseline():
+    reg = MetricsRegistry()
+    got = []
+    hb = Heartbeater(reg, "e0", interval_ms=50, send=got.append)
+    reg.counter("t.n", role="e0").inc(10)
+    hb.beat()
+    reg.counter("t.n", role="e0").inc(4)
+    hb.beat()
+    hb.beat()  # idle interval
+    assert [p["seq"] for p in got] == [1, 2, 3]
+    assert got[0]["counters"]["t.n{role=e0}"] == 10
+    assert got[1]["counters"]["t.n{role=e0}"] == 4
+    assert "t.n{role=e0}" not in got[2]["counters"]  # zero deltas pruned
+    assert all(p["executor_id"] == "e0" and p["v"] == 1 for p in got)
+
+
+def test_heartbeater_outbox_mode_bounded_and_drained():
+    reg = MetricsRegistry()
+    hb = Heartbeater(reg, "e1", interval_ms=50, outbox_size=3)
+    for _ in range(5):
+        reg.counter("t.n").inc()
+        hb.beat()
+    drained = hb.drain()
+    assert len(drained) == 3  # bounded: oldest dropped
+    assert [p["seq"] for p in drained] == [3, 4, 5]  # seq keeps counting
+    assert hb.drain() == []
+
+
+def test_heartbeater_pause_skips_beats_and_resume_recovers():
+    reg = MetricsRegistry()
+    got = []
+    hb = Heartbeater(reg, "e2", interval_ms=50, send=got.append)
+    hb.beat()
+    hb.pause()
+    assert hb.beat() is None
+    hb.resume()
+    hb.beat()
+    assert [p["seq"] for p in got] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# hub units: ingest, gaps, missed heartbeats, detection
+# ---------------------------------------------------------------------------
+
+def _payload(eid, seq, wall, counters=None, hists=None):
+    return {"v": 1, "executor_id": eid, "seq": seq, "wall_ms": wall,
+            "interval_ms": 100, "counters": counters or {},
+            "gauges": {}, "histograms": hists or {}}
+
+
+def test_hub_folds_payloads_and_tracks_series():
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", registry=reg, interval_ms=100, ring_size=8)
+    for seq in range(1, 4):
+        hub.ingest(_payload("e0", seq, seq * 100, {"transport.read_bytes": 10}))
+    assert hub.executors() == ["e0"]
+    assert len(hub.series("e0")) == 3
+    assert hub.rollups()["e0"]["counters"]["transport.read_bytes"] == 30
+    s = hub.summary()
+    assert s["executors"]["e0"]["windows"] == 3
+    assert s["missed_heartbeats"] == 0
+    hub.ingest({"bogus": True})  # malformed: dropped, counted
+    assert reg.snapshot()["counters"]["telemetry.bad_payloads{role=drv}"] == 1
+    hub.stop()
+
+
+def test_hub_seq_jump_records_gap_and_missed_gauge():
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", registry=reg, interval_ms=100, ring_size=8)
+    hub.ingest(_payload("e0", 1, 100))
+    hub.ingest(_payload("e0", 5, 500))  # 3 heartbeats lost in transit
+    wins = hub.series("e0").windows()
+    assert [w.gap for w in wins] == [False, True]
+    missed = reg.snapshot()["gauges"]["telemetry.missed_heartbeats{role=drv}"]
+    assert missed["value"] == 3
+    hub.stop()
+
+
+def test_hub_wall_clock_silence_counts_missed_once_and_marks_resume_gap():
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", registry=reg, interval_ms=100, ring_size=8)
+    hub.ingest(_payload("e0", 1, 100))
+    hub.ingest(_payload("e1", 1, 110))
+    # e1 goes silent; e0's later heartbeats advance the hub's clock
+    assert hub.check_missed(now_ms=200) == []  # within 2.5 intervals
+    hub.ingest(_payload("e0", 2, 600))
+    missed = reg.snapshot()["gauges"]["telemetry.missed_heartbeats{role=drv}"]
+    assert missed["value"] == 1
+    assert hub.summary()["executors"]["e1"]["missed"] is True
+    hub.ingest(_payload("e0", 3, 900))  # silence continues: counted ONCE
+    missed = reg.snapshot()["gauges"]["telemetry.missed_heartbeats{role=drv}"]
+    assert missed["value"] == 1
+    # e1 resumes: its next window carries the gap marker and re-arms
+    hub.ingest(_payload("e1", 2, 900))
+    assert hub.series("e1").windows()[-1].gap is True
+    assert hub.summary()["executors"]["e1"]["missed"] is False
+    hub.stop()
+
+
+def test_straggler_detector_flags_busy_outlier_only():
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", registry=reg, interval_ms=100,
+                       ring_size=16, straggler_z=3)
+    # three executors, identical work; e1's map tasks run 20x longer
+    for seq in range(1, 4):
+        for eid, ms in (("e0", 10.0), ("e1", 200.0), ("e2", 11.0)):
+            hub.ingest(_payload(
+                eid, seq, seq * 100,
+                {f"transport.read_bytes{{role={eid}}}": 1 << 20},
+                {f"engine.task_ms{{kind=map,role={eid}}}":
+                 {"count": 2, "sum": ms}},
+            ))
+    rep = hub.straggler_report()
+    assert rep["stragglers"] == ["e1"]
+    flags = rep["executors"]["e1"]["flags"]
+    assert flags and flags[0]["kind"] == "busy"
+    # gauges follow the report (updated online on ingest)
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["telemetry.straggler{executor=e1,role=drv}"]["value"] == 1
+    assert gauges["telemetry.straggler{executor=e0,role=drv}"]["value"] == 0
+    assert gauges["telemetry.stragglers{role=drv}"]["value"] == 1
+    hub.stop()
+
+
+def test_straggler_detector_needs_three_participants():
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", registry=reg, interval_ms=100, ring_size=8)
+    for eid, ms in (("e0", 10.0), ("e1", 500.0)):
+        hub.ingest(_payload(eid, 1, 100, None,
+                            {"engine.task_ms": {"count": 1, "sum": ms}}))
+    assert hub.straggler_report()["stragglers"] == []  # 2 < MIN_PARTICIPANTS
+    hub.stop()
+
+
+def test_straggler_advisory_reaches_health_registry():
+    from sparkrdma_tpu.resilience import SourceHealthRegistry
+
+    reg = MetricsRegistry()
+    health = SourceHealthRegistry(TpuShuffleConf(), role="drv")
+    hub = TelemetryHub(role="drv", registry=reg, health=health,
+                       interval_ms=100, ring_size=8)
+    for seq in (1, 2):
+        for eid, ms in (("e0", 10.0), ("e1", 400.0), ("e2", 12.0)):
+            hub.ingest(_payload(eid, seq, seq * 100, None,
+                                {"engine.task_ms": {"count": 1, "sum": ms}}))
+    assert set(health.suspects()) == {"e1"}
+    # advisory only: no circuit opened
+    assert health.states() == {} or all(
+        s == "closed" for s in health.states().values()
+    )
+    hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*")*\})? \S+$'
+)
+
+
+def _validate_openmetrics(text):
+    """Line-format validator: every line is HELP, TYPE, EOF, or a
+    sample matching the exposition grammar; every sample's family was
+    declared by a TYPE line first; document ends with # EOF."""
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF"
+    typed = {}
+    for ln in lines[:-1]:
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            _, kind, family, rest = ln.split(" ", 3)
+            if kind == "TYPE":
+                typed[family] = rest
+            continue
+        assert _SAMPLE_RE.match(ln), f"bad sample line: {ln!r}"
+        name = ln.split("{", 1)[0].split(" ", 1)[0]
+        candidates = {name}
+        for suffix in ("_total", "_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                candidates.add(name[: -len(suffix)])
+        assert candidates & typed.keys(), f"sample without TYPE: {ln!r}"
+    return typed
+
+
+def test_render_openmetrics_validates_and_maps_names():
+    reg = MetricsRegistry()
+    reg.counter("transport.read_bytes", role="exec-0", purpose="data").inc(42)
+    reg.gauge("reader.inflight_bytes", role="exec-0").set(7)
+    h = reg.histogram("rpc.handle_ms", bounds=(1, 10), role="exec-0")
+    for v in (0.5, 5, 100):
+        h.observe(v)
+    text = render_openmetrics(reg.snapshot())
+    typed = _validate_openmetrics(text)
+    assert typed["transport_read_bytes"] == "counter"
+    assert typed["reader_inflight_bytes"] == "gauge"
+    assert typed["reader_inflight_bytes_hwm"] == "gauge"
+    assert typed["rpc_handle_ms"] == "histogram"
+    assert ('transport_read_bytes_total{purpose="data",role="exec-0"} 42'
+            in text)
+    # cumulative buckets + +Inf == count
+    assert 'rpc_handle_ms_bucket{le="1",role="exec-0"} 1' in text
+    assert 'rpc_handle_ms_bucket{le="10",role="exec-0"} 2' in text
+    assert 'rpc_handle_ms_bucket{le="+Inf",role="exec-0"} 3' in text
+    assert 'rpc_handle_ms_count{role="exec-0"} 3' in text
+
+
+def test_openmetrics_escapes_label_values():
+    reg = MetricsRegistry()
+    reg.counter("x.n", note='quote " back \\ slash').inc()
+    text = render_openmetrics(reg.snapshot())
+    _validate_openmetrics(text)
+    assert 'note="quote \\" back \\\\ slash"' in text
+
+
+def test_extract_snapshot_finds_registry_in_artifacts():
+    reg = MetricsRegistry()
+    reg.counter("x.n").inc(3)
+    snap = reg.snapshot()
+    assert extract_snapshot(snap)["counters"]["x.n"] == 3
+    assert extract_snapshot({"obs_registry": snap})["counters"]["x.n"] == 3
+    assert extract_snapshot({"registry": snap})["counters"]["x.n"] == 3
+    with pytest.raises(ValueError):
+        extract_snapshot({"workloads": []})
+
+
+def test_openmetrics_http_server_scrapes():
+    reg = MetricsRegistry()
+    reg.counter("x.scraped").inc(9)
+    srv = OpenMetricsServer(lambda: render_openmetrics(reg.snapshot()))
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode("utf-8")
+            ctype = resp.headers["Content-Type"]
+    finally:
+        srv.stop()
+    assert "openmetrics-text" in ctype
+    assert "x_scraped_total 9" in body
+    _validate_openmetrics(body)
+
+
+# ---------------------------------------------------------------------------
+# e2e: in-process cluster (push path)
+# ---------------------------------------------------------------------------
+
+def test_context_e2e_straggler_flagged_under_injected_skew(tmp_path):
+    """ISSUE 5 acceptance: >= 2 executors with >= 3 windows each on the
+    driver hub; under a one-executor injected delay the detector flags
+    exactly that executor."""
+    from sparkrdma_tpu.engine.context import TpuContext
+
+    conf = TpuShuffleConf({
+        "tpu.shuffle.obs.telemetry.intervalMs": "40",
+        "tpu.shuffle.shuffleWriteBlockSize": "65536",
+        "tpu.shuffle.shuffleReadBlockSize": "65536",
+    })
+    spec = "stage:delay:0:delay_ms=150,stage=map_task,peer=exec-1"
+    with faults.installed(spec):
+        with TpuContext(num_executors=3, conf=conf) as ctx:
+            data = [(f"k{i % 50}", 1) for i in range(2000)]
+            out = (ctx.parallelize(data, num_partitions=6)
+                   .reduce_by_key(lambda a, b: a + b).collect())
+            assert len(out) == 50
+            deadline = time.monotonic() + 10
+            hub = ctx.driver.telemetry
+            while time.monotonic() < deadline:
+                if (len(hub.executors()) >= 3
+                        and all(len(hub.series(e)) >= 3
+                                for e in hub.executors())
+                        and hub.straggler_report()["stragglers"]):
+                    break
+                time.sleep(0.05)
+            ctx.telemetry_flush()
+            assert len(hub.executors()) >= 2
+            for e in hub.executors():
+                assert len(hub.series(e)) >= 3
+            rep = hub.straggler_report()
+            assert rep["stragglers"] == ["exec-1"]  # it, and only it
+            assert set(ctx.driver.health.suspects()) == {"exec-1"}
+            snap = ctx.driver.metrics_snapshot()
+            assert snap["telemetry"]["stragglers"] == ["exec-1"]
+
+
+def test_context_e2e_lost_heartbeat_tolerated():
+    """A paused (lost) heartbeater never fails the job: the gap is
+    recorded, telemetry.missed_heartbeats increments, results are
+    correct."""
+    from sparkrdma_tpu.engine.context import TpuContext
+    from sparkrdma_tpu.obs import get_registry
+
+    conf = TpuShuffleConf({"tpu.shuffle.obs.telemetry.intervalMs": "30"})
+    with TpuContext(num_executors=2, conf=conf) as ctx:
+        hub = ctx.driver.telemetry
+        lost = ctx.heartbeaters[1]
+        g_missed = get_registry().gauge(
+            "telemetry.missed_heartbeats", role=ctx.driver.executor_id
+        )
+        # both executors heartbeat at least once, then exec-1 goes dark
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(hub.executors()) < 2:
+            time.sleep(0.02)
+        assert len(hub.executors()) == 2
+        before = g_missed.value
+        lost.pause()
+        data = [(f"k{i % 20}", 1) for i in range(500)]
+        out = (ctx.parallelize(data, num_partitions=4)
+               .reduce_by_key(lambda a, b: a + b).collect())
+        assert len(out) == 20  # job unaffected
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and g_missed.value <= before:
+            time.sleep(0.05)
+        assert g_missed.value > before
+        lost.resume()
+        eid = lost.executor_id
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and not any(w.gap for w in hub.series(eid).windows())):
+            time.sleep(0.05)
+        assert any(w.gap for w in hub.series(eid).windows())
+
+
+def test_context_e2e_flight_recorder_names_failed_group(tmp_path):
+    """On FetchFailedError the hub dumps a JSON artifact that loads and
+    names the failed group."""
+    from sparkrdma_tpu.engine.context import TpuContext
+    from sparkrdma_tpu.shuffle.errors import FetchFailedError, ShuffleError
+
+    conf = TpuShuffleConf({
+        "tpu.shuffle.obs.telemetry.intervalMs": "40",
+        "tpu.shuffle.resilience.maxFetchAttempts": "2",
+        "tpu.shuffle.resilience.retryBackoffMs": "5",
+        "tpu.shuffle.obs.telemetry.flightDir": str(tmp_path),
+    })
+    with faults.installed("read:fail:0"):
+        with TpuContext(num_executors=2, conf=conf) as ctx:
+            data = [(f"k{i % 10}", 1) for i in range(200)]
+            with pytest.raises(ShuffleError):
+                (ctx.parallelize(data, num_partitions=4)
+                 .reduce_by_key(lambda a, b: a + b).collect())
+            path = ctx.driver.telemetry.last_flight_path
+            assert path is not None and path.startswith(str(tmp_path))
+            with open(path) as f:
+                doc = json.load(f)
+    assert doc["kind"] == "sparkrdma_flight_record"
+    assert doc["error"]["type"] == FetchFailedError.__name__
+    failed = doc["failed_group"]
+    assert failed["shuffle_id"] >= 1 and "partition_id" in failed
+    assert "source" in failed  # the manager the fetch was aimed at
+    assert doc["executors"]  # per-executor ring windows present
+    assert "source_health" in doc and "stragglers" in doc
+
+
+# ---------------------------------------------------------------------------
+# e2e: multi-process cluster (pull path over the task protocol)
+# ---------------------------------------------------------------------------
+
+def test_cluster_e2e_pull_path_builds_driver_time_series():
+    from sparkrdma_tpu.engine.cluster import ClusterContext
+
+    conf = TpuShuffleConf({"tpu.shuffle.obs.telemetry.intervalMs": "50"})
+    with ClusterContext(num_executors=2, conf=conf) as cc:
+        def mk(i):
+            return lambda: iter(
+                [(f"k{j % 20}", 1) for j in range(i * 300, (i + 1) * 300)]
+            )
+
+        res = cc.run_map_reduce(
+            [mk(i) for i in range(4)], num_partitions=4,
+            reduce_fn=lambda it: sum(v for _, v in it),
+        )
+        assert sum(res) == 1200
+        hub = cc.driver.telemetry
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if (len(hub.executors()) >= 2
+                    and all(len(hub.series(e)) >= 3 for e in hub.executors())):
+                break
+            time.sleep(0.05)
+        assert sorted(hub.executors()) == ["proc-exec-0", "proc-exec-1"]
+        for e in hub.executors():
+            assert len(hub.series(e)) >= 3
+        # the workers' engine.task_ms instrumentation crossed the wire
+        roll = hub.rollups()
+        assert any(
+            k.startswith("engine.task_ms")
+            for e in roll for k in roll[e]["histograms"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI egress
+# ---------------------------------------------------------------------------
+
+def test_obs_cli_openmetrics_and_from_snapshot(tmp_path):
+    from sparkrdma_tpu.obs.__main__ import main
+
+    reg_file = tmp_path / "artifact.json"
+    reg = MetricsRegistry()
+    reg.counter("cli.n", role="x").inc(5)
+    reg_file.write_text(json.dumps({"obs_registry": reg.snapshot()}))
+    out_file = tmp_path / "out.prom"
+    rc = main(["--openmetrics", str(out_file),
+               "--from-snapshot", str(reg_file)])
+    assert rc == 0
+    text = out_file.read_text()
+    _validate_openmetrics(text)
+    assert 'cli_n_total{role="x"} 5' in text
+
+
+def test_obs_cli_flight_recorder_pretty_printer(tmp_path, capsys):
+    from sparkrdma_tpu.obs.__main__ import main
+
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", registry=reg, interval_ms=100, ring_size=8)
+    hub.ingest(_payload("e0", 1, 100, {"c": 1}))
+    err = RuntimeError("boom")
+    err.shuffle_id, err.partition_id = 7, 3
+    path = hub.flight_record("unit_abort", error=err,
+                             path=str(tmp_path / "flight.json"))
+    hub.stop()
+    rc = main(["--flight-recorder", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "unit_abort" in out
+    assert "shuffle_id=7" in out and "partition_id=3" in out
+    assert "e0: 1 windows" in out
