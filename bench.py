@@ -592,6 +592,120 @@ def bench_striping_ab() -> dict:
     return out
 
 
+def bench_device_fetch_ab(dry_run: bool = False) -> dict:
+    """Interleaved device-pull vs host-fetch A/B pairs, SAME run.
+
+    The device fetch plane (DESIGN.md §17) moves arena-resident blocks
+    HBM→HBM behind the same resolver API the host path uses; this A/B
+    toggles ``deviceFetch.enabled`` between sides of each pair so both
+    fetch the SAME published blocks through the same
+    ``fetch_device_blocks`` call. Both sides byte-verify against the
+    source; the B side additionally proves the pulls actually engaged
+    (plane counter moved, zero fallbacks). Under ``JAX_PLATFORMS=cpu``
+    the mover is the emulated ``jax.device_put`` path, so ~1.0x is the
+    expected speedup — the row exists to keep the plane measured and
+    regression-gated, and to light up on a real ICI mesh.
+
+    ``dry_run`` shrinks the volume for the CI obs smoke
+    (``bench.py --ab device_fetch``)."""
+    from sparkrdma_tpu.obs import get_registry
+    from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
+    from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    out = {}
+    n_parts = 4 if dry_run else 8
+    block = (256 << 10) if dry_run else (2 << 20)
+    n_pairs = 1 if dry_run else 3
+    rounds = 2 if dry_run else 4
+    conf = TpuShuffleConf()
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex_map = TpuShuffleManager(conf, is_driver=False, executor_id="dfab-map")
+    ex_red = TpuShuffleManager(conf, is_driver=False, executor_id="dfab-red")
+    driver.register_shuffle(
+        BaseShuffleHandle(
+            shuffle_id=71, num_maps=1, partitioner=HashPartitioner(n_parts)
+        )
+    )
+    io_map, io_red = DeviceShuffleIO(ex_map), DeviceShuffleIO(ex_red)
+    rng = np.random.default_rng(31)
+    data = {
+        p: rng.integers(0, 256, block, np.uint8) for p in range(n_parts)
+    }
+    total = n_parts * block
+    reg = get_registry()
+    pulls = reg.counter("device_fetch.plane.pulls", role="dfab-red")
+    fallbacks = reg.counter("device_fetch.plane.fallbacks", role="dfab-red")
+    try:
+        io_map.publish_device_blocks(71, data)
+
+        def fetch_round(verify: bool) -> None:
+            got = io_red.fetch_device_blocks(71, 0, n_parts, timeout_s=120)
+            try:
+                if verify:
+                    for p in range(n_parts):
+                        if bytes(got[p][0].read(0, block)) != data[p].tobytes():
+                            raise SystemExit(
+                                "BENCH FAILED: device-fetch A/B bytes differ"
+                            )
+            finally:
+                for bufs in got.values():
+                    for b in bufs:
+                        b.free()
+
+        def side(enabled: bool):
+            conf.set("tpu.shuffle.deviceFetch.enabled", str(enabled).lower())
+            fetch_round(verify=True)  # warm + byte-identity, untimed
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                fetch_round(verify=False)
+            dt = time.perf_counter() - t0
+            return rounds * total / dt / 1e9
+
+        pairs = []
+        for _ in range(n_pairs):
+            a = side(False)
+            p0, f0 = pulls.value, fallbacks.value
+            b = side(True)
+            if pulls.value - p0 < (rounds + 1) * n_parts:
+                raise SystemExit(
+                    "BENCH FAILED: device-fetch A/B pulls did not engage"
+                )
+            if fallbacks.value != f0:
+                raise SystemExit(
+                    "BENCH FAILED: device-fetch A/B fell back mid-pair"
+                )
+            pairs.append(
+                {"host_gbps": round(a, 3), "device_gbps": round(b, 3)}
+            )
+        med_a = float(np.median([p["host_gbps"] for p in pairs]))
+        med_b = float(np.median([p["device_gbps"] for p in pairs]))
+        out["ab_device_fetch"] = {
+            "pairs": pairs,
+            "host_fetch_gbps": round(med_a, 3),
+            "device_fetch_gbps": round(med_b, 3),
+            "speedup": round(med_b / med_a, 3) if med_a else None,
+            "mover": "pallas-ici" if _is_tpu() else "emulated-device-put",
+        }
+    finally:
+        io_red.stop()
+        io_map.stop()
+        ex_red.stop()
+        ex_map.stop()
+        driver.stop()
+    return out
+
+
+def _is_tpu() -> bool:
+    try:
+        from sparkrdma_tpu.ops.remote_copy import is_tpu_mesh
+
+        return is_tpu_mesh()
+    except Exception:
+        return False
+
+
 def _socket_roofline() -> float:
     """Raw single-core loopback TCP throughput at the bench's block
     size — the streamed plane's machine limit on this rig. Moves the
@@ -897,7 +1011,19 @@ def main() -> None:
         default=0,
         help="seed for deterministic fault placement (corrupt byte choice)",
     )
+    parser.add_argument(
+        "--ab",
+        default="",
+        choices=["", "device_fetch"],
+        help="run ONE A/B at reduced volume and print its JSON — the CI "
+        "obs smoke's dry-run mode (e.g. --ab device_fetch)",
+    )
     args = parser.parse_args()
+    if args.ab == "device_fetch":
+        record = bench_device_fetch_ab(dry_run=True)
+        record["dry_run"] = True
+        print(json.dumps(record))
+        return
     plan = None
     if args.fault_plan:
         plan = faults.FaultPlan.parse(args.fault_plan, seed=args.fault_seed)
@@ -916,6 +1042,7 @@ def main() -> None:
     out.update(bench_native_reads())
     out.update(bench_consume_pipelined_ab())
     out.update(bench_striping_ab())
+    out.update(bench_device_fetch_ab())
     import jax
 
     out.update(bench_device(jax))

@@ -99,7 +99,7 @@ def bench_device_terasort(scale: float):
 
 
 def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
-                       executors: int = 2):
+                       executors: int = 2, device_fetch: bool = True):
     """One measured TeraSort with the WHOLE framework in the loop.
 
     Map side plays Spark's part (host sorts, as the reference leaves to
@@ -149,7 +149,16 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
                 exp_sum[r] += sel.sum(dtype=np.uint32)
             exp_xor[r] ^= np.bitwise_xor.reduce(sel) if len(sel) else np.uint32(0)
 
-    conf = TpuShuffleConf({"tpu.shuffle.transport": transport})
+    # device_fetch=False pins the HOST transport plane under test: in
+    # this single-process harness every executor's arena is
+    # mesh-visible, so the device plane would otherwise pull every
+    # remote block HBM->HBM and the host plane would idle (DESIGN.md
+    # §17 — exactly what it should do in production, but not what a
+    # transport benchmark wants)
+    conf = TpuShuffleConf({
+        "tpu.shuffle.transport": transport,
+        "tpu.shuffle.deviceFetch.enabled": str(device_fetch).lower(),
+    })
     driver = TpuShuffleManager(conf, is_driver=True)
     execs = [
         TpuShuffleManager(conf, is_driver=False, executor_id=f"e2e-{i}")
@@ -351,7 +360,9 @@ def bench_e2e_terasort(gb: float, transport: str, reducers: int = 8,
         reducer_io = ios[0]
 
         def fetch_blocks(r):
-            got = reducer_io.fetch_host_blocks(99, r, r + 1, timeout_s=120)
+            got = reducer_io.fetch_host_blocks(
+                99, r, r + 1, timeout_s=120, dtype=np.uint32
+            )
             return got.get(r, [])
 
         def verify_blocks(_r, blocks):

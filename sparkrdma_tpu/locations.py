@@ -36,6 +36,14 @@ class BlockLocation:
     they travel in the PublishPartitionLocations frame's trailing
     checksum extension (rpc.py) so legacy parsers
     (examples/foreign_client.c) keep working. algo 0 = no checksum.
+
+    ``device_coords``/``arena_handle``/``arena_offset`` are the device
+    fetch plane's HBM-side address of the same bytes: the publisher's
+    mesh device id, its HBM-arena slab handle (ops/hbm_arena.py) and
+    byte offset within it. Like the checksum tag they ride a trailing
+    frame extension (rpc.py), never the legacy 16-byte form. An
+    ``arena_handle`` of 0 means no device copy exists (arena handles
+    start at 1); the host triple above is always the durable fallback.
     """
 
     address: int
@@ -43,8 +51,16 @@ class BlockLocation:
     mkey: int
     checksum: int = 0
     checksum_algo: int = 0
+    device_coords: int = -1
+    arena_handle: int = 0
+    arena_offset: int = 0
 
     SERIALIZED_SIZE = _BLOCK.size
+
+    @property
+    def has_device(self) -> bool:
+        """True when a device-resident copy is advertised."""
+        return self.arena_handle != 0
 
     def write(self, out: BinaryIO) -> None:
         out.write(_BLOCK.pack(self.address, self.length, self.mkey))

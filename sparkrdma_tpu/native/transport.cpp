@@ -334,6 +334,9 @@ struct Node {
   // from Python for tests and the bench harness)
   std::atomic<uint64_t> stat_file_reads{0};
   std::atomic<uint64_t> stat_streamed_reads{0};
+  // sub-ranges created by striping a single large block's pread across
+  // the worker pool (observable: tests assert the stripe engaged)
+  std::atomic<uint64_t> stat_block_stripes{0};
   // parts created by splitting multi-block pread tasks (observable so
   // tests can assert the split actually engaged)
   std::atomic<uint64_t> stat_split_parts{0};
@@ -1173,6 +1176,42 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         size_t nworkers = n->file_worker_count.load(std::memory_order_acquire);
         uint64_t total_bytes = 0;
         for (uint64_t L : t.lens) total_bytes += L;
+        // intra-block striping: a single fat block (the common
+        // one-partition fetch) would otherwise ride one worker while
+        // the rest of the pool idles. Expand any block >= 4MB into
+        // contiguous sub-ranges of the SAME file (offset advanced,
+        // identity fields unchanged) so the byte-balanced split below
+        // can spread ONE block across file_workers threads. Only for
+        // the pread path: dst placement is cumulative over lens, so
+        // sub-block boundaries are invisible downstream; mapped tasks
+        // keep per-block records and must stay whole.
+        if (!t.mapped && nworkers > 1) {
+          std::vector<FileRef> xfiles;
+          std::vector<uint64_t> xlens;
+          for (size_t i = 0; i < t.files.size(); i++) {
+            uint64_t blen = t.lens[i];
+            // each sub-range stays >= 1MB so the stripe never degrades
+            // into syscall-overhead-dominated slivers
+            size_t sparts = (size_t)std::min<uint64_t>(
+                (uint64_t)nworkers, blen / (1ull << 20));
+            if (blen >= (4ull << 20) && sparts > 1) {
+              uint64_t chunk = (blen + sparts - 1) / sparts;
+              for (uint64_t done = 0; done < blen; done += chunk) {
+                FileRef sub = t.files[i];
+                sub.off += done;
+                xfiles.push_back(std::move(sub));
+                xlens.push_back(std::min(chunk, blen - done));
+              }
+              n->stat_block_stripes.fetch_add(
+                  (blen + chunk - 1) / chunk);
+              continue;
+            }
+            xfiles.push_back(std::move(t.files[i]));
+            xlens.push_back(blen);
+          }
+          t.files = std::move(xfiles);
+          t.lens = std::move(xlens);
+        }
         // split only when the work amortizes the dispatch (a few MB
         // floor) and balance parts by BYTES, not block count — one fat
         // block among small ones must not leave a part doing all the
@@ -1736,6 +1775,9 @@ uint64_t srt_stat_streamed_reads(void* np) {
   return ((Node*)np)->stat_streamed_reads.load();
 }
 
+uint64_t srt_stat_block_stripes(void* np) {
+  return ((Node*)np)->stat_block_stripes.load();
+}
 uint64_t srt_stat_split_parts(void* np) {
   return ((Node*)np)->stat_split_parts.load();
 }

@@ -97,6 +97,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.srt_stat_streamed_reads.argtypes = [ctypes.c_void_p]
         lib.srt_stat_split_parts.restype = ctypes.c_uint64
         lib.srt_stat_split_parts.argtypes = [ctypes.c_void_p]
+        lib.srt_stat_block_stripes.restype = ctypes.c_uint64
+        lib.srt_stat_block_stripes.argtypes = [ctypes.c_void_p]
         lib.srt_connect.restype = ctypes.c_uint64
         lib.srt_connect.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,

@@ -535,6 +535,36 @@ class DeviceBufferManager:
             for h in handles:
                 self._unpin(h)
 
+    @contextlib.contextmanager
+    def pinned_if_resident(self, handle: int):
+        """Pin ``handle`` for the block iff it is live AND still
+        device-resident; yield the buffer, or None otherwise.
+
+        The device fetch plane's eviction-race guard: unlike
+        ``pinned_on_device`` this NEVER climbs a spilled buffer back —
+        a source shard the arena already demoted must degrade to the
+        host fetch path, not trigger a restore (which could thrash the
+        publisher's budget) and never error. While the body runs the
+        pin keeps spill victim picks away, so ``.array`` stays valid
+        for the duration of the pull."""
+        try:
+            buf = self.resolve(handle)
+        except KeyError:
+            yield None
+            return
+        self._pin(handle)
+        try:
+            # re-check residency under the pin: a spill that won the
+            # race before the pin landed leaves array None / tiers set
+            if buf.array is None or buf.spilled:
+                yield None
+            else:
+                with self._lock:
+                    live = self._handles.get(handle) is buf
+                yield buf if live else None
+        finally:
+            self._unpin(handle)
+
     def ensure_device_all(self, bufs) -> None:
         """Restore a working set to HBM without the set victimizing
         itself. NOTE: protection ends when this returns — consumers

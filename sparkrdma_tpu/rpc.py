@@ -125,24 +125,39 @@ class PublishPartitionLocationsMsg(RpcMsg):
     _CK_MARKER = 0xFFFF
     _CK_HDR = struct.Struct(">HI")
     _CK_ITEM = struct.Struct(">BI")
+    # per-segment device-location extension (device fetch plane):
+    # written AFTER the checksum extension, BEFORE the trace extension.
+    # Same marker trick with 0xFFFE — equally impossible as a host
+    # length — and the header deliberately shares _CK_HDR's 6-byte
+    # (marker, count) shape so the single peek below disambiguates both
+    # extensions. Layout: marker(2) count(4), then per location
+    # device_coords(i4) arena_handle(u4) arena_offset(u8); handle 0 =
+    # that location has no device copy (arena handles start at 1).
+    _DEV_MARKER = 0xFFFE
+    _DEV_HDR = struct.Struct(">HI")
+    _DEV_ITEM = struct.Struct(">iIQ")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
         ck_fixed = self._CK_HDR.size if has_ck else 0
         ck_per_loc = self._CK_ITEM.size if has_ck else 0
+        has_dev = any(loc.block.arena_handle for loc in self.locations)
+        dev_fixed = self._DEV_HDR.size if has_dev else 0
+        dev_per_loc = self._DEV_ITEM.size if has_dev else 0
         budget = (
             seg_size
             - SEG_HEADER.size
             - self._HDR.size
             - self._TRACE_EXT.size
             - ck_fixed
+            - dev_fixed
         )
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
         groups: List[List[PartitionLocation]] = [[]]
         used = 0
         for loc in self.locations:
-            sz = loc.serialized_size() + ck_per_loc
+            sz = loc.serialized_size() + ck_per_loc + dev_per_loc
             if sz > budget:
                 raise ValueError(
                     f"partition location ({sz} bytes) exceeds segment budget {budget}"
@@ -175,6 +190,16 @@ class PublishPartitionLocationsMsg(RpcMsg):
                             loc.block.checksum & 0xFFFFFFFF,
                         )
                     )
+            if has_dev and group:
+                buf.write(self._DEV_HDR.pack(self._DEV_MARKER, len(group)))
+                for loc in group:
+                    buf.write(
+                        self._DEV_ITEM.pack(
+                            loc.block.device_coords,
+                            loc.block.arena_handle & 0xFFFFFFFF,
+                            loc.block.arena_offset,
+                        )
+                    )
             buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
@@ -189,8 +214,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
         end = len(payload)
         # locations are each >= 28 bytes, so a residue of exactly 8 is
         # the trailing trace-id extension (absent from legacy senders);
-        # a 0xFFFF two-byte peek is the checksum extension, which is
-        # always the last element before the trace id
+        # a 0xFFFF two-byte peek is the checksum extension, a 0xFFFE
+        # peek the device-location extension — both sit between the
+        # locations and the trace id, in either order
         while end - inp.tell() > cls._TRACE_EXT.size:
             pos = inp.tell()
             peek = inp.read(cls._CK_HDR.size)
@@ -214,7 +240,26 @@ class PublishPartitionLocationsMsg(RpcMsg):
                     else:
                         # count mismatch (corrupt/foreign ext): skip it
                         inp.read(count * cls._CK_ITEM.size)
-                    break
+                    continue
+                if marker == cls._DEV_MARKER:
+                    if count == len(locs):
+                        for i in range(count):
+                            coords, handle, offset = cls._DEV_ITEM.unpack(
+                                inp.read(cls._DEV_ITEM.size)
+                            )
+                            if handle:
+                                locs[i] = replace(
+                                    locs[i],
+                                    block=replace(
+                                        locs[i].block,
+                                        device_coords=coords,
+                                        arena_handle=handle,
+                                        arena_offset=offset,
+                                    ),
+                                )
+                    else:
+                        inp.read(count * cls._DEV_ITEM.size)
+                    continue
             inp.seek(pos)
             locs.append(PartitionLocation.read(inp))
         trace_id = 0

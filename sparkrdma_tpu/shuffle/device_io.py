@@ -30,6 +30,12 @@ from sparkrdma_tpu.ops.hbm_arena import (
     DeviceBufferManager,
     _size_class,
 )
+from sparkrdma_tpu.shuffle.device_fetch import (
+    DeviceFetchPlane,
+    DevicePulledBlock,
+    register_arena,
+    unregister_arena,
+)
 from sparkrdma_tpu.shuffle.errors import FetchFailedError, MetadataFetchFailedError
 from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
@@ -187,6 +193,12 @@ class DeviceShuffleIO:
         # published host-side registered buffers per shuffle (kept alive
         # until unpublish — the serving side of one-sided READs)
         self._published: Dict[int, List] = {}
+        # device fetch plane (DESIGN.md §17): arena-staged copies of the
+        # same published blocks, served HBM->HBM to mesh-visible pullers;
+        # the registry entry is what makes THIS endpoint's arena visible
+        self._arena_published: Dict[int, List[DeviceBuffer]] = {}
+        register_arena(manager.executor_id, self._dev)
+        self._plane = DeviceFetchPlane(conf, self._dev, manager.executor_id)
         self._lock = threading.Lock()
         # fetch-phase accounting (tunnel-vs-framework attribution):
         #   transport_s — waiting for bytes to ARRIVE in host memory
@@ -215,8 +227,12 @@ class DeviceShuffleIO:
         the map pipeline, so the next shard's device sort can overlap
         this shard's driver RPC (publish_staged)."""
         mgr = self._manager
+        conf = mgr.conf
+        dev_plane = conf.device_fetch_enabled
+        dev_min = conf.device_fetch_min_block_bytes
         locs: List[PartitionLocation] = []
         staged = []
+        arena_staged: List[DeviceBuffer] = []
         for pid, arr in partitions.items():
             # HBM -> registered memory in ONE host copy: the device
             # readback lands in a host array and its bytes move straight
@@ -229,18 +245,46 @@ class DeviceShuffleIO:
                 host.reshape(-1).view(np.uint8)
             )
             staged.append(buf)
-            locs.append(
-                PartitionLocation(
-                    mgr.local_manager_id,
-                    pid,
-                    BlockLocation(0, nbytes, buf.mkey),
-                )
+            # integrity tag computed HERE, while the bytes are still
+            # cache-hot from the copy above and this runs on the map
+            # pool's parallel stage workers — the manager's publish-time
+            # funnel (_with_checksum) skips already-tagged locations, so
+            # the serial publish RPC no longer pays a CRC per block
+            ck_algo = ck = 0
+            if conf.resilience_checksums and nbytes:
+                ck_algo, ck = _checksum.compute(host.reshape(-1).view(np.uint8))
+            block = BlockLocation(
+                0, nbytes, buf.mkey, checksum=ck, checksum_algo=ck_algo
             )
+            if dev_plane and nbytes >= dev_min:
+                # keep a second, device-resident copy in the HBM arena
+                # and advertise its coordinates: a mesh-visible reducer
+                # pulls it HBM->HBM (device_fetch.py) while the host
+                # triple above stays the durable fallback. Best-effort —
+                # arena pressure (MemoryError) just skips the extension.
+                try:
+                    abuf = self._dev.stage_view(
+                        host.reshape(-1).view(np.uint8), nbytes,
+                        dtype=host.dtype,
+                    )
+                except MemoryError:
+                    abuf = None
+                if abuf is not None:
+                    arena_staged.append(abuf)
+                    block = BlockLocation(
+                        0, nbytes, buf.mkey,
+                        checksum=ck, checksum_algo=ck_algo,
+                        device_coords=getattr(self._dev.device, "id", 0),
+                        arena_handle=abuf.handle,
+                        arena_offset=0,
+                    )
+            locs.append(PartitionLocation(mgr.local_manager_id, pid, block))
         # buffers go under shuffle ownership as soon as they're staged:
         # a publish failure (or an aborted pipeline) still releases them
         # through unpublish/stop
         with self._lock:
             self._published.setdefault(shuffle_id, []).extend(staged)
+            self._arena_published.setdefault(shuffle_id, []).extend(arena_staged)
         return locs
 
     def publish_staged(
@@ -253,6 +297,28 @@ class DeviceShuffleIO:
         output for the driver's completeness barrier)."""
         self._manager.publish_partition_locations(
             shuffle_id, -1, locs, num_map_outputs=num_map_outputs
+        )
+
+    def publish_staged_batch(
+        self,
+        shuffle_id: int,
+        windows: List[List[PartitionLocation]],
+        num_map_outputs_each: int = 1,
+    ) -> None:
+        """Publish N staged shards' location windows in ONE driver RPC.
+
+        The driver's publish handler already *sums* ``num_map_outputs``
+        into its completeness barrier and keys every location by its
+        own partition id, so a batch is just the concatenated windows
+        plus the summed count — no new RPC type. This is the map loop's
+        answer to publish contention: instead of N serial round-trips
+        through the driver's per-shuffle lock, the executor pays one."""
+        if not windows:
+            return
+        locs = [loc for window in windows for loc in window]
+        self._manager.publish_partition_locations(
+            shuffle_id, -1, locs,
+            num_map_outputs=num_map_outputs_each * len(windows),
         )
 
     def publish_device_blocks(
@@ -342,6 +408,14 @@ class DeviceShuffleIO:
 
         try:
             for loc in locations:
+                # device plane first: an arena-resident source pulls
+                # HBM->HBM and skips host transport AND staging; any
+                # planner refusal (spilled, too small, foreign arena,
+                # dtype) silently continues into the host path below
+                dev = self._plane.try_pull(loc, dtype)
+                if dev is not None:
+                    out.setdefault(loc.partition_id, []).append(dev)
+                    continue
                 if loc.manager_id.executor_id == my_id:
                     # local short-circuit straight from the registered
                     # region — DMA'd directly, never copied to bytes.
@@ -511,6 +585,7 @@ class DeviceShuffleIO:
         start_partition: int,
         end_partition: int,
         timeout_s: Optional[float] = None,
+        dtype=np.uint8,
     ) -> Dict[int, List[HostBlock]]:
         """Transport half of a reduce-group fetch: pull every block of
         ``[start, end)`` into host memory and return unverified
@@ -519,7 +594,14 @@ class DeviceShuffleIO:
         to :meth:`verify_host_block` / :meth:`stage_host_block` on
         later pipeline stages. Same single-deadline semantics and
         ownership rules as :meth:`fetch_device_blocks`; the caller owns
-        every returned handle (``release()`` in a finally)."""
+        every returned handle (``release()`` in a finally).
+
+        ``dtype`` is the slab type :meth:`stage_host_block` will later
+        be asked for: the device-pull planner needs it up front (a
+        pulled slab arrives typed), so callers that stage non-uint8
+        pass it here too. Blocks the planner claims come back as
+        :class:`DevicePulledBlock` entries — already in HBM, flowing
+        through the same verify/stage seams."""
         mgr = self._manager
         conf = mgr.conf
         if timeout_s is None:
@@ -546,6 +628,12 @@ class DeviceShuffleIO:
         arrivals: "queue.Queue[int]" = queue.Queue()
         try:
             for loc in locations:
+                dev = self._plane.try_pull(loc, dtype)
+                if dev is not None:
+                    out.setdefault(loc.partition_id, []).append(
+                        DevicePulledBlock(shuffle_id, loc, dev)
+                    )
+                    continue
                 if loc.manager_id.executor_id == my_id:
                     # local short-circuit: the handle aliases the
                     # publisher's registered span directly (released by
@@ -699,6 +787,11 @@ class DeviceShuffleIO:
         happens AFTER the wire delivered intact bytes."""
         mgr = self._manager
         my_id = mgr.executor_id
+        if isinstance(hb, DevicePulledBlock):
+            # device path: the checksum was verified at publish on the
+            # same staged bytes and the pull is a DMA, not a socket —
+            # trusted, no host bytes to gate (DESIGN.md §17)
+            return hb
         plan = _faults.active()
         if plan is not None:
             plan.on_stage("decode", [hb.data])
@@ -728,7 +821,13 @@ class DeviceShuffleIO:
         verified block into a pooled device slab and release the host
         resource. ``stage_view`` blocks until the device transfer
         completes, so releasing right after is safe. The ``stage``
-        fault seam (``stage=stage``) fires before the transfer."""
+        fault seam (``stage=stage``) fires before the transfer.
+
+        A :class:`DevicePulledBlock` is already an HBM slab: ownership
+        transfers to the caller with no transfer, no release, no fault
+        seam (there are no host bytes to corrupt)."""
+        if isinstance(hb, DevicePulledBlock):
+            return hb.take()
         plan = _faults.active()
         if plan is not None:
             plan.on_stage("stage", [hb.data])
@@ -762,15 +861,26 @@ class DeviceShuffleIO:
         return snap
 
     def unpublish(self, shuffle_id: int) -> None:
-        """Release the registered buffers serving a shuffle's blocks."""
+        """Release the registered buffers serving a shuffle's blocks,
+        and the arena copies the device plane advertised. A puller
+        racing this free sees the handle gone (or the slab recycled)
+        at its residency re-check and degrades to host fetch — which
+        then also finds the host buffer gone only if the whole shuffle
+        is being torn down, the pre-existing contract."""
         with self._lock:
             staged = self._published.pop(shuffle_id, [])
+            arena = self._arena_published.pop(shuffle_id, [])
         for buf in staged:
             self._manager.buffer_manager.put(buf)
+        for abuf in arena:
+            abuf.free()
 
     def stop(self) -> None:
         with self._lock:
-            shuffles = list(self._published.keys())
+            shuffles = set(self._published.keys()) | set(
+                self._arena_published.keys()
+            )
         for sid in shuffles:
             self.unpublish(sid)
+        unregister_arena(self._manager.executor_id, self._dev)
         self._dev.stop()

@@ -86,6 +86,15 @@ class TpuShuffleManager:
         # per-executor attribution of published map outputs, so peer loss
         # can re-arm the barrier (shuffle_id -> executor_id -> count)
         self._maps_by_exec: Dict[int, Dict[str, int]] = {}
+        # publish/fetch mutation of ONE shuffle's registry serializes on
+        # that shuffle's lock, not the manager-wide ``_lock`` — under a
+        # contended map pool, concurrent shuffles' publishes used to
+        # queue on one lock (WORKLOADS: 21.2 s contended vs 3.2 s
+        # uncontended publish busy). ``_lock`` stays the guard for the
+        # registry-of-shuffles structure itself and everything not
+        # keyed by shuffle id. Ordering: shuffle lock OUTER, ``_lock``
+        # inner (held only for dict lookups, never across handler work).
+        self._shuffle_locks: Dict[int, threading.Lock] = {}
 
         # executor state
         self._fetch_futures: Dict[Tuple[int, int], Future] = {}
@@ -218,6 +227,14 @@ class TpuShuffleManager:
                 "rpc.handle_ms", role=self.executor_id, type=mtype
             ).observe((time.perf_counter() - t0) * 1e3)
 
+    def _shuffle_lock(self, shuffle_id: int) -> threading.Lock:
+        """Per-shuffle registry lock (driver side). Sharding by
+        shuffle_id lets concurrent publishes for independent shuffles
+        proceed in parallel; the global ``_lock`` is only held for the
+        dict lookup (lock order: shuffle lock OUTER, ``_lock`` inner)."""
+        with self._lock:
+            return self._shuffle_locks.setdefault(shuffle_id, threading.Lock())
+
     def _handle_hello(self, msg: ManagerHelloMsg) -> None:
         """Driver: record membership, connect back, announce to all (:121-161)."""
         if not self.is_driver:
@@ -269,8 +286,9 @@ class TpuShuffleManager:
         """
         if not self.is_driver:
             return
-        with self._lock:
-            handle = self._registered.get(msg.shuffle_id)
+        with self._shuffle_lock(msg.shuffle_id):
+            with self._lock:
+                handle = self._registered.get(msg.shuffle_id)
             if handle is not None and self._maps_done.get(msg.shuffle_id, 0) < handle.num_maps:
                 self._deferred_fetches.setdefault(msg.shuffle_id, []).append(msg)
                 return
@@ -285,8 +303,9 @@ class TpuShuffleManager:
             partitions=f"{msg.start_partition}:{msg.end_partition}",
         ):
             locs: List[PartitionLocation] = []
-            with self._lock:
-                shuffle = self._partition_locations.get(msg.shuffle_id)
+            with self._shuffle_lock(msg.shuffle_id):
+                with self._lock:
+                    shuffle = self._partition_locations.get(msg.shuffle_id)
                 if shuffle is not None:
                     for pid in range(msg.start_partition, msg.end_partition):
                         locs.extend(shuffle.get(pid, ()))
@@ -320,8 +339,10 @@ class TpuShuffleManager:
             # writers publish with partition_id = -1; re-key every location
             # by its own partition id (:68-95)
             to_reply: List[FetchPartitionLocationsMsg] = []
-            with self._lock:
-                shuffle = self._partition_locations.setdefault(msg.shuffle_id, {})
+            with self._shuffle_lock(msg.shuffle_id):
+                with self._lock:
+                    shuffle = self._partition_locations.setdefault(msg.shuffle_id, {})
+                    handle = self._registered.get(msg.shuffle_id)
                 for loc in msg.locations:
                     shuffle.setdefault(loc.partition_id, []).append(loc)
                 if msg.is_last and msg.num_map_outputs > 0:
@@ -335,7 +356,6 @@ class TpuShuffleManager:
                         exec_id = msg.locations[0].manager_id.executor_id
                         by_exec = self._maps_by_exec.setdefault(msg.shuffle_id, {})
                         by_exec[exec_id] = by_exec.get(exec_id, 0) + msg.num_map_outputs
-                    handle = self._registered.get(msg.shuffle_id)
                     if handle is not None and done >= handle.num_maps:
                         to_reply = self._deferred_fetches.pop(msg.shuffle_id, [])
             for fetch in to_reply:
@@ -365,19 +385,25 @@ class TpuShuffleManager:
             return
         with self._lock:
             self._manager_ids.pop(executor_id, None)
-            for shuffle in self._partition_locations.values():
-                for pid in list(shuffle.keys()):
-                    shuffle[pid] = [
-                        loc
-                        for loc in shuffle[pid]
-                        if loc.manager_id.executor_id != executor_id
-                    ]
-            for shuffle_id, by_exec in self._maps_by_exec.items():
-                lost = by_exec.pop(executor_id, 0)
-                if lost:
-                    self._maps_done[shuffle_id] = (
-                        self._maps_done.get(shuffle_id, 0) - lost
-                    )
+            shuffle_ids = set(self._partition_locations) | set(self._maps_by_exec)
+        for shuffle_id in shuffle_ids:
+            with self._shuffle_lock(shuffle_id):
+                with self._lock:
+                    shuffle = self._partition_locations.get(shuffle_id)
+                    by_exec = self._maps_by_exec.get(shuffle_id)
+                if shuffle is not None:
+                    for pid in list(shuffle.keys()):
+                        shuffle[pid] = [
+                            loc
+                            for loc in shuffle[pid]
+                            if loc.manager_id.executor_id != executor_id
+                        ]
+                if by_exec is not None:
+                    lost = by_exec.pop(executor_id, 0)
+                    if lost:
+                        self._maps_done[shuffle_id] = (
+                            self._maps_done.get(shuffle_id, 0) - lost
+                        )
         logger.info("pruned locations of lost executor %s", executor_id)
 
     # ------------------------------------------------------------------
@@ -565,6 +591,7 @@ class TpuShuffleManager:
             self._maps_done.pop(shuffle_id, None)
             self._deferred_fetches.pop(shuffle_id, None)
             self._maps_by_exec.pop(shuffle_id, None)
+            self._shuffle_locks.pop(shuffle_id, None)
 
     # ------------------------------------------------------------------
     def get_channel_to(self, mid: ShuffleManagerId, purpose: str = "rpc"):

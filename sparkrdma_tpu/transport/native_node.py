@@ -776,6 +776,14 @@ class NativeTpuNode:
             return 0
         return self._lib.srt_stat_split_parts(np_handle)
 
+    def block_stripes(self) -> int:
+        """Sub-ranges created by striping single large blocks' preads
+        across the worker pool (0 = the stripe never engaged)."""
+        np_handle = self._np
+        if not np_handle:
+            return 0
+        return self._lib.srt_stat_block_stripes(np_handle)
+
     def _close_channel(self, ch: NativeTpuChannel) -> None:
         ch._dead.set()
         if not self._stopped.is_set():

@@ -387,12 +387,22 @@ class TpuShuffleConf:
     # -- transport selection ----------------------------------------------
     @property
     def transport(self) -> str:
-        """Host transport data plane: ``python`` or ``native`` (C++ epoll
-        loop, sparkrdma_tpu/native/transport.cpp). Both speak the same
-        wire format and interoperate; native falls back to python when
-        the toolchain is unavailable."""
-        raw = (self._conf.get(PREFIX + "transport", "python") or "python").lower()
-        return raw if raw in ("python", "native") else "python"
+        """Host transport data plane: ``auto`` (default), ``python`` or
+        ``native`` (C++ epoll loop, sparkrdma_tpu/native/transport.cpp).
+        Both speak the same wire format and interoperate. ``auto``
+        resolves to native when the toolchain is available — that is the
+        only transport with mapped (zero-copy page-cache) delivery, the
+        measured-fastest consume path — and python otherwise; setting
+        ``transport=python`` is the escape hatch back to the pure-Python
+        plane."""
+        raw = (self._conf.get(PREFIX + "transport", "auto") or "auto").lower()
+        if raw not in ("python", "native", "auto"):
+            raw = "auto"
+        if raw == "auto":
+            from sparkrdma_tpu.native import transport_lib
+
+            return "native" if transport_lib.available() else "python"
+        return raw
 
     @property
     def file_fastpath(self) -> bool:
@@ -454,6 +464,24 @@ class TpuShuffleConf:
         """Host-RAM cap for slabs spilled out of HBM; overflow cascades
         to disk (tier 3 of SURVEY §7.3(4)). 0 = unbounded host tier."""
         return self._bytes("hbm.hostSpillMaxBytes", "0", 0, 1 << 44)
+
+    @property
+    def device_fetch_enabled(self) -> bool:
+        """Device fetch plane (shuffle/device_fetch.py): publish HBM
+        arena coordinates next to the host triple and let reduce tasks
+        pull arena-resident blocks HBM->HBM (Pallas remote copy on TPU
+        meshes, ``jax.device_put`` emulation elsewhere) instead of
+        through host sockets. The host path always remains the
+        fallback; disabling only suppresses device locations and
+        planner pulls."""
+        return self._bool("deviceFetch.enabled", True)
+
+    @property
+    def device_fetch_min_block_bytes(self) -> int:
+        """Blocks smaller than this skip the device plane: per-pull
+        dispatch overhead beats the HBM bandwidth win on tiny blocks,
+        and small blocks churn arena slabs (min slab class 16 KiB)."""
+        return self._bytes("deviceFetch.minBlockBytes", "16k", 0, 1 << 33)
 
     @property
     def hbm_spill_dir(self) -> str:

@@ -14,7 +14,10 @@ from sparkrdma_tpu.utils.config import TpuShuffleConf
 
 @pytest.fixture
 def cluster():
-    conf = TpuShuffleConf()
+    # python transport: several tests here script TpuChannel read
+    # behavior (fault/deadline/ordering) at the python verb layer; the
+    # auto default would resolve to native and bypass those seams
+    conf = TpuShuffleConf({"tpu.shuffle.transport": "python"})
     driver = TpuShuffleManager(conf, is_driver=True)
     ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
     ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")

@@ -21,6 +21,17 @@ from sparkrdma_tpu.utils.config import TpuShuffleConf
 pytestmark = pytest.mark.faults
 
 
+@pytest.fixture(autouse=True)
+def _python_transport(monkeypatch):
+    """Every injection seam in this module lives in the python verb
+    layer (TpuChannel monkeypatches, the fault plan's read hooks), so
+    pin the transport: the ``auto`` default resolves to native when the
+    toolchain is present and would route reads around the seams."""
+    monkeypatch.setattr(
+        TpuShuffleConf, "transport", property(lambda self: "python")
+    )
+
+
 def _counter_total(snap_prefix_delta: dict) -> int:
     return sum(snap_prefix_delta.get("counters", {}).values())
 

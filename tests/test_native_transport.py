@@ -582,7 +582,9 @@ def test_device_fetch_uses_mapped_delivery_cross_process():
 
     from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
 
-    conf = _native_conf()
+    # device plane off: same-process arenas are mesh-visible, so HBM
+    # pulls would short-circuit the mapped-delivery path under test
+    conf = _native_conf({"tpu.shuffle.deviceFetch.enabled": "false"})
     driver = TpuShuffleManager(conf, is_driver=True)
     ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="map-0")
     ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="map-1")
@@ -699,6 +701,48 @@ def test_multiblock_file_read_splits_across_workers():
         f, s = cli.read_path_stats()
         assert f == 1 and s == 0, (f, s)
         # the split actually engaged (not just the whole-task path)
+        assert cli.split_parts() >= 2, cli.split_parts()
+    finally:
+        cli.stop()
+        srv.stop()
+
+
+def test_single_block_pread_stripes_across_workers():
+    """ONE fat block (the common single-partition fetch) is expanded
+    into contiguous sub-ranges so its pread spreads over file_workers
+    threads instead of riding one: bytes exact, one fast-path read,
+    stripe counter engaged."""
+    import numpy as np
+
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+
+    srv = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", False, "stripe-srv")
+    cli = NativeTpuNode(
+        TpuShuffleConf({"tpu.shuffle.fileWorkers": "4"}),
+        "127.0.0.1", True, "stripe-cli",
+    )
+    try:
+        rng = np.random.default_rng(29)
+        buf = TpuBuffer(srv.pd, 8 << 20, register=True)
+        src = rng.integers(0, 256, 8 << 20, np.uint8)
+        np.frombuffer(buf.view, np.uint8)[:] = src
+        ch = cli.get_channel("127.0.0.1", srv.port, purpose="data")
+        # one 8 MiB block: above the 4 MiB stripe floor, enough for
+        # >= 2 sub-ranges of >= 1 MiB each across 4 workers
+        dst = memoryview(bytearray(8 << 20))
+        done, errs = threading.Event(), []
+        ch.read_in_queue(
+            FnListener(lambda _: done.set(), lambda e: (errs.append(e), done.set())),
+            [dst],
+            [(buf.mkey, 0, 8 << 20)],
+        )
+        assert done.wait(10) and not errs, errs
+        assert bytes(dst) == src.tobytes(), "striped single-block bytes differ"
+        f, s = cli.read_path_stats()
+        assert f == 1 and s == 0, (f, s)
+        assert cli.block_stripes() >= 2, cli.block_stripes()
+        # the byte-balanced split then fans the sub-ranges out as parts
         assert cli.split_parts() >= 2, cli.split_parts()
     finally:
         cli.stop()

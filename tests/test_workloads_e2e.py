@@ -24,6 +24,15 @@ _spec.loader.exec_module(run_workloads)
 
 
 def test_e2e_terasort_python_transport():
+    from sparkrdma_tpu.obs import get_registry
+
+    # reducer runs on executor e2e-0; the planner tags its counters
+    # with the fetching executor's role
+    pulls = get_registry().counter("device_fetch.plane.pulls", role="e2e-0")
+    falls = get_registry().counter(
+        "device_fetch.plane.fallbacks", role="e2e-0"
+    )
+    p0, f0 = pulls.value, falls.value
     run_workloads.bench_e2e_terasort(0.002, "python", reducers=4, executors=2)
     rec = run_workloads.RECORDS[-1]
     assert rec["workload"] == "terasort_e2e"
@@ -33,13 +42,24 @@ def test_e2e_terasort_python_transport():
     assert m["registered_pool_allocs_by_class"]
     assert m["hbm_pool_allocs_by_class"]
     assert m["hbm_spill_count"] == 0
+    # single-process harness: every arena is mesh-visible, so the
+    # device fetch plane (DESIGN.md §17) pulls the peer executor's
+    # blocks HBM->HBM — and the checksum verification above already
+    # proved those pulled bytes correct end to end
+    assert pulls.value - p0 > 0, "device plane did not engage in e2e"
+    assert falls.value - f0 == 0
 
 
 # gate on the TOOLCHAIN, not available(): a transport.cpp compile
 # breakage must fail this test, not skip it
 @pytest.mark.skipif(not toolchain_available(), reason="no g++ toolchain")
 def test_e2e_terasort_native_transport():
-    run_workloads.bench_e2e_terasort(0.002, "native", reducers=4, executors=2)
+    # device_fetch=False: this test pins the native HOST plane, which
+    # the (mesh-visible, same-process) device plane would otherwise
+    # short-circuit entirely
+    run_workloads.bench_e2e_terasort(
+        0.002, "native", reducers=4, executors=2, device_fetch=False
+    )
     rec = run_workloads.RECORDS[-1]
     assert rec["transport"] == "native"
     m = rec["metrics"]
