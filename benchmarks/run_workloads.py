@@ -653,8 +653,17 @@ def bench_device_terasort_skew(scale: float):
     ) if len(sorter._step_cache) > 1 else 0
     t0 = time.perf_counter()
     out = sorter.sort(keys)
-    dt = time.perf_counter() - t0
+    dt_static = time.perf_counter() - t0
     assert all(out[i] <= out[i + 1] for i in range(0, min(2000, n - 1)))
+
+    # adaptive control: sampled quantile edges + sampled capacity
+    # (shuffle/planner.py plan_edges) replace the overflow-retry ladder
+    out_ad = sorter.sort(keys, adaptive=True)  # warm adaptive executable
+    assert len(out_ad) == n
+    t0 = time.perf_counter()
+    out_ad = sorter.sort(keys, adaptive=True)
+    dt = time.perf_counter() - t0
+    assert all(out_ad[i] <= out_ad[i + 1] for i in range(0, min(2000, n - 1)))
 
     # uniform control at the same n, same process (executables warm)
     uni = rng.integers(0, 1 << 32, n, dtype=np.uint32)
@@ -667,13 +676,17 @@ def bench_device_terasort_skew(scale: float):
         keys=n, zipf_a=1.5,
         capacity_doublings=doublings_warm,
         uniform_control_s=round(dt_uni, 4),
+        static_plan_s=round(dt_static, 4),
         skew_overhead_x=round(dt / dt_uni, 3) if dt_uni > 0 else None,
+        skew_overhead_x_static=(
+            round(dt_static / dt_uni, 3) if dt_uni > 0 else None
+        ),
         devices=len(jax.devices()),
         note=(
-            "skew cost = overflow-retry executions at doubled bucket "
-            "capacity (static-shape strategy, SURVEY §7.3(2)); "
-            "recompiles amortized by the in-process step cache + "
-            "persistent compilation cache"
+            "primary timing = adaptive plan (sampled quantile edges, "
+            "shuffle/planner.py) — one right-sized execution; "
+            "skew_overhead_x_static = the pre-planner overflow-retry "
+            "ladder at doubled bucket capacities (SURVEY §7.3(2))"
         ),
     )
 

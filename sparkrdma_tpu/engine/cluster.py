@@ -165,10 +165,20 @@ class ClusterContext:
         by_worker: Dict[int, List] = {}
         for i, fn in enumerate(map_fns):
             by_worker.setdefault(i % len(self.workers), []).append((i, fn))
+        # push routes for the merge plane (shuffle/merge.py): where each
+        # executor's push client reaches its peers' task servers
+        push_routes = {
+            w.executor_id: ("127.0.0.1", w.task_port) for w in self.workers
+        }
         futures = [
             self._pool.submit(
                 self.workers[w].request,
-                {"kind": "map_batch", "handle": handle, "tasks": tasks},
+                {
+                    "kind": "map_batch",
+                    "handle": handle,
+                    "tasks": tasks,
+                    "push_routes": push_routes,
+                },
             )
             for w, tasks in by_worker.items()
         ]
@@ -177,12 +187,26 @@ class ClusterContext:
         for w in self.workers:
             w.request({"kind": "finalize", "shuffle_id": handle.shuffle_id})
 
-        # split the partition range across workers
+        # split the partition range across workers: contiguous static
+        # bounds, re-planned from the published per-partition sizes by
+        # the adaptive partitioner when enabled (shuffle/planner.py) so
+        # a hot partition's worker is not also loaded with its neighbors
         n = len(self.workers)
         bounds = [
             (w * num_partitions // n, (w + 1) * num_partitions // n)
             for w in range(n)
         ]
+        if self.conf.planner_enabled:
+            from sparkrdma_tpu.shuffle.planner import AdaptivePartitioner
+
+            size_map = self.driver.partition_sizes(handle.shuffle_id)
+            sizes = [size_map.get(p, 0) for p in range(num_partitions)]
+            if any(sizes):
+                ranges = AdaptivePartitioner(self.conf).plan(sizes, n)
+                # pad with empty ranges so every worker keeps a slot
+                bounds = ranges + [
+                    (num_partitions, num_partitions)
+                ] * (n - len(ranges))
         futures = [
             self._pool.submit(
                 self.workers[w].request,

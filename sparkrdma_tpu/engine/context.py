@@ -188,18 +188,42 @@ class TpuContext:
         assert last_error is not None
         raise last_error
 
+    def _partition_weights(self, rdd: RDD) -> Dict[int, int]:
+        """Published per-partition byte totals of rdd's direct shuffle
+        dependency, when the partition counts line up — the adaptive
+        scheduling signal (shuffle/planner.py): the heaviest reduce
+        partitions are SUBMITTED first so a hot partition never starts
+        last and stretches the stage tail behind the task-pool bound.
+        Results still collect in partition order."""
+        if not self.conf.planner_enabled:
+            return {}
+        for dep in self._shuffle_deps(rdd):
+            if (
+                dep.handle is not None
+                and dep.partitioner.num_partitions == rdd.num_partitions
+            ):
+                sizes = self.driver.partition_sizes(dep.handle.shuffle_id)
+                if sizes:
+                    return sizes
+        return {}
+
     def run_job(self, rdd: RDD) -> List:
         """Compute all partitions of rdd; recompute stages on fetch failure."""
         for attempt in range(2):
             try:
                 self.ensure_parents(rdd)
-                futures = [
-                    self._pool.submit(lambda p=p: list(rdd.compute(p)))
-                    for p in range(rdd.num_partitions)
-                ]
+                order = list(range(rdd.num_partitions))
+                weights = self._partition_weights(rdd)
+                if weights:
+                    order.sort(key=lambda p: -weights.get(p, 0))
+                futures = {
+                    p: self._pool.submit(lambda p=p: list(rdd.compute(p)))
+                    for p in order
+                }
                 out: List = []
                 errors = []
-                for f in futures:
+                for p in range(rdd.num_partitions):
+                    f = futures[p]
                     e = f.exception()
                     if e is not None:
                         errors.append(e)

@@ -115,6 +115,11 @@ class Worker:
             # goes through the map pool; the first failure propagates
             # after ALL have settled (writers must reach stop() so a
             # failed task poisons/aborts cleanly before the reply)
+            routes = req.get("push_routes")
+            if routes and self.manager.push_client is not None:
+                # {executor_id: (host, task_port)}: where this worker's
+                # push client ships sealed blocks (shuffle/merge.py)
+                self.manager.push_client.set_routes(routes)
             futures = [
                 self.manager.map_pool.submit(self._run_map, req["handle"], mid, fn)
                 for mid, fn in req["tasks"]
@@ -127,6 +132,20 @@ class Worker:
         if kind == "finalize":
             self.manager.finalize_maps(req["shuffle_id"])
             return {"ok": True}
+        if kind == "push_blocks":
+            # push/merge plane ingest (shuffle/merge.py): the reply is
+            # sent only after any seal-and-publish this batch triggers,
+            # so a synchronous pushing finalizer gets ordering for free
+            ep = self.manager.merge_endpoint
+            accepted = 0
+            if ep is not None:
+                accepted = ep.push_blocks(
+                    req["shuffle_id"],
+                    req["source"],
+                    req.get("blocks") or [],
+                    req.get("final"),
+                )
+            return {"ok": True, "result": accepted}
         if kind == "reduce":
             handle = req["handle"]
             t0 = time.perf_counter()

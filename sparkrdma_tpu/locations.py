@@ -44,6 +44,15 @@ class BlockLocation:
     frame extension (rpc.py), never the legacy 16-byte form. An
     ``arena_handle`` of 0 means no device copy exists (arena handles
     start at 1); the host triple above is always the durable fallback.
+
+    ``merged_cover`` marks a *merged* location (push-based merge plane,
+    shuffle/merge.py): the block is one sequential segment holding the
+    concatenated payloads of ``merged_cover`` original per-map blocks
+    of its partition. 0 = a plain per-map block. Readers choose
+    merged-else-original: a merged location substitutes for ALL the
+    partition's originals only when ``merged_cover`` equals their
+    count, and the originals always remain the durable fallback. Rides
+    a trailing frame extension (rpc.py), never the legacy 16-byte form.
     """
 
     address: int
@@ -54,6 +63,7 @@ class BlockLocation:
     device_coords: int = -1
     arena_handle: int = 0
     arena_offset: int = 0
+    merged_cover: int = 0
 
     SERIALIZED_SIZE = _BLOCK.size
 
@@ -61,6 +71,11 @@ class BlockLocation:
     def has_device(self) -> bool:
         """True when a device-resident copy is advertised."""
         return self.arena_handle != 0
+
+    @property
+    def is_merged(self) -> bool:
+        """True when this is a merged segment (covers >= 1 originals)."""
+        return self.merged_cover != 0
 
     def write(self, out: BinaryIO) -> None:
         out.write(_BLOCK.pack(self.address, self.length, self.mkey))

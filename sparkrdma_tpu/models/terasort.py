@@ -35,7 +35,12 @@ import numpy as np
 from sparkrdma_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkrdma_tpu.ops.sort import device_sort, merge_received, split_sorted
+from sparkrdma_tpu.ops.sort import (
+    device_sort,
+    merge_received,
+    split_sorted,
+    split_sorted_edges,
+)
 from sparkrdma_tpu.parallel.mesh import make_mesh, shard_spec
 
 KEY_BITS = 32
@@ -142,12 +147,12 @@ class TeraSorter:
         self._step_cache = {}
 
     # ------------------------------------------------------------------
-    def _build_step(self, n_local: int, capacity: int):
+    def _build_step(self, n_local: int, capacity: int, adaptive: bool = False):
         e = self.num_shards
         axes = tuple(self.mesh.axis_names)
         spec = shard_spec(self.mesh)
 
-        def shard_fn(keys):  # keys: [n_local] uint32 on one device
+        def shard_fn(keys, edges=None):  # keys: [n_local] uint32 shard
             if e == 1:
                 # single-shard short circuit: no split, no exchange — the
                 # reference's invariant #2 (local partitions never loop
@@ -163,9 +168,19 @@ class TeraSorter:
             # of range-edge slices — measured ~25x cheaper than the
             # argsort/scatter pack at 32M keys (benchmarks/sort_study.py)
             local = device_sort(keys)
-            slab, counts, overflowed = split_sorted(
-                local, e, capacity, KEY_BITS, fill=int(SENTINEL)
-            )
+            if adaptive:
+                # sampled quantile edges ride as DATA (replicated over
+                # the mesh): the adaptive planner's cuts balance the
+                # receive counts under skew, and a re-plan changes only
+                # values — the executable is reused (ops/sort.py
+                # split_sorted_edges, shuffle/planner.py plan_edges)
+                slab, counts, overflowed = split_sorted_edges(
+                    local, edges, capacity, fill=int(SENTINEL)
+                )
+            else:
+                slab, counts, overflowed = split_sorted(
+                    local, e, capacity, KEY_BITS, fill=int(SENTINEL)
+                )
             # one all_to_all delivers every peer's bucket — the one-sided
             # READ plane collapsed into a single XLA collective
             recv = jax.lax.all_to_all(slab, axes, split_axis=0, concat_axis=0, tiled=True)
@@ -175,23 +190,32 @@ class TeraSorter:
             overflowed = jax.lax.pmax(overflowed.astype(jnp.int32), axes)
             return merged, total[None], overflowed
 
+        # the non-adaptive step keeps its historic single-argument
+        # signature (bench.py / graft entry call step(n)(keys)); only
+        # the adaptive variant threads the replicated edges array
+        in_specs = (spec, P()) if adaptive else (spec,)
         fn = shard_map(
             shard_fn,
             mesh=self.mesh,
-            in_specs=(spec,),
+            in_specs=in_specs,
             out_specs=(spec, spec, P()),
             check_vma=False,
         )
         return jax.jit(fn)
 
-    def step(self, n_local: int, capacity: Optional[int] = None):
+    def step(
+        self,
+        n_local: int,
+        capacity: Optional[int] = None,
+        adaptive: bool = False,
+    ):
         """The jitted SPMD sort step for [E*n_local] global keys."""
         if capacity is None:
             capacity = self.default_capacity(n_local)
-        key = (n_local, capacity)
+        key = (n_local, capacity, adaptive)
         fn = self._step_cache.get(key)
         if fn is None:
-            fn = self._build_step(n_local, capacity)
+            fn = self._build_step(n_local, capacity, adaptive)
             self._step_cache[key] = fn
         return fn
 
@@ -200,11 +224,21 @@ class TeraSorter:
         return max(8, cap)
 
     # ------------------------------------------------------------------
-    def sort(self, keys: np.ndarray) -> np.ndarray:
+    def sort(
+        self,
+        keys: np.ndarray,
+        adaptive: bool = False,
+        sample_size: int = 4096,
+    ) -> np.ndarray:
         """Host-facing total sort of uint32 keys (pads to shard multiple).
 
         Retries with doubled capacity on bucket overflow (skewed data),
-        mirroring the pool's size-class re-rounding."""
+        mirroring the pool's size-class re-rounding. With ``adaptive``
+        the shard range edges come from a host-side key sample
+        (shuffle/planner.py ``plan_edges``) instead of static top bits,
+        and the capacity class is sized from the sampled shard shares —
+        under zipf skew this replaces several overflow-retry executions
+        at doubled capacity with ONE right-sized run."""
         n = len(keys)
         e = self.num_shards
         n_local = int(math.ceil(n / e))
@@ -213,12 +247,37 @@ class TeraSorter:
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         dev = jax.device_put(padded, sharding)
 
-        capacity = self.default_capacity(n_local)
+        use_adaptive = adaptive and e > 1 and n > 0
+        if use_adaptive:
+            from sparkrdma_tpu.shuffle.planner import (
+                capacity_from_sample,
+                plan_edges,
+            )
+
+            sample = keys[:: max(1, n // max(1, sample_size))][:sample_size]
+            edges_np = plan_edges(sample, e)
+            # + e covers the injected SENTINEL padding (< e keys, all
+            # routed to the last shard); clamp to n_local (a sender
+            # holds no more)
+            capacity = min(
+                n_local, capacity_from_sample(sample, e, n_local,
+                                              edges=edges_np) + e,
+            )
+        else:
+            edges_np = np.zeros((max(0, e - 1),), dtype=np.uint32)
+            capacity = self.default_capacity(n_local)
+        edges = jnp.asarray(edges_np, jnp.uint32)
+
         for _ in range(8):
-            merged, totals, overflowed = self.step(n_local, capacity)(dev)
+            fn = self.step(n_local, capacity, adaptive=use_adaptive)
+            merged, totals, overflowed = (
+                fn(dev, edges) if use_adaptive else fn(dev)
+            )
             if not bool(overflowed):
                 break
-            capacity *= 2
+            # n_local is a hard ceiling: one sender holds n_local keys,
+            # so no per-destination run can exceed it
+            capacity = min(n_local, capacity * 2)
         else:
             raise RuntimeError("terasort bucket overflow after 8 capacity doublings")
 

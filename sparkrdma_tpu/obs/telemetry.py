@@ -285,6 +285,12 @@ class TelemetryHub:
         # the executor resumes
         self._missed_counted: Dict[str, bool] = {}
         self._last_report: dict = {"stragglers": []}
+        # per-shuffle per-partition published byte totals, fed by the
+        # driver's publish handler as map outputs (incremental windows
+        # included) land — the adaptive partition planner's skew signal
+        # (shuffle/planner.py). Bounded: oldest shuffle evicted.
+        self._partition_bytes: Dict[int, Dict[int, int]] = {}
+        self._partition_bytes_max_shuffles = 64
         self._last_file_write_ms = 0
         self.last_flight_path: Optional[str] = None
         self.last_flight: Optional[dict] = None
@@ -302,6 +308,26 @@ class TelemetryHub:
             self._http = OpenMetricsServer(
                 self.render_openmetrics, port=self._http_port
             )
+
+    # -- per-partition skew statistics (adaptive planner input) --------
+    def record_partition_bytes(self, shuffle_id: int, pid: int, nbytes: int) -> None:
+        """Accumulate one published location's bytes for (shuffle, pid)."""
+        with self._lock:
+            per = self._partition_bytes.get(shuffle_id)
+            if per is None:
+                while len(self._partition_bytes) >= self._partition_bytes_max_shuffles:
+                    self._partition_bytes.pop(next(iter(self._partition_bytes)))
+                per = self._partition_bytes[shuffle_id] = {}
+            per[pid] = per.get(pid, 0) + int(nbytes)
+
+    def partition_bytes(self, shuffle_id: int) -> Dict[int, int]:
+        """Per-partition byte totals observed so far for one shuffle."""
+        with self._lock:
+            return dict(self._partition_bytes.get(shuffle_id, ()))
+
+    def drop_partition_bytes(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._partition_bytes.pop(shuffle_id, None)
 
     # -- ingest --------------------------------------------------------
     def ingest(self, payload: Mapping) -> None:

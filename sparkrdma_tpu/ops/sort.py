@@ -160,6 +160,45 @@ def split_sorted(
     return slab, jnp.minimum(counts, capacity), overflowed
 
 
+def split_sorted_edges(
+    sorted_keys: jax.Array, edges: jax.Array, capacity: int, fill: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`split_sorted` with the range edges as a TRACED argument.
+
+    ``edges`` is an ascending ``[P-1]`` array: partition ``e`` owns
+    keys in ``[edges[e-1], edges[e])``. The static variant derives its
+    edges from the key's top bits, which balances only a uniform key
+    space; this one takes sampled quantile edges from the adaptive
+    planner (shuffle/planner.py ``plan_edges``) so a zipf-skewed run
+    balances its receive counts instead of overflowing one shard's
+    capacity class. Because ``edges`` is data, not structure, the same
+    compiled step serves every re-plan — no recompile when the sample
+    shifts the cuts. ``P`` comes from ``edges.shape[0] + 1`` (static)
+    and need not be a power of two. Same return contract as
+    :func:`split_sorted`."""
+    n = sorted_keys.shape[0]
+    p = edges.shape[0] + 1
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.searchsorted(sorted_keys, edges.astype(sorted_keys.dtype))
+         .astype(jnp.int32)]
+    )
+    ends = jnp.concatenate([starts[1:], jnp.asarray([n], jnp.int32)])
+    counts = ends - starts
+    overflowed = jnp.any(counts > capacity)
+    padded = jnp.concatenate(
+        [sorted_keys, jnp.full((capacity,), fill, sorted_keys.dtype)]
+    )
+    rows = [
+        jax.lax.dynamic_slice(padded, (starts[e],), (capacity,))
+        for e in range(p)
+    ]
+    slab = jnp.stack(rows, axis=0)
+    valid = jnp.arange(capacity, dtype=jnp.int32)[None, :] < counts[:, None]
+    slab = jnp.where(valid, slab, jnp.asarray(fill, sorted_keys.dtype))
+    return slab, jnp.minimum(counts, capacity), overflowed
+
+
 def merge_received(
     slab: jax.Array, counts: jax.Array, sentinel: int
 ) -> Tuple[jax.Array, jax.Array]:

@@ -136,6 +136,17 @@ class PublishPartitionLocationsMsg(RpcMsg):
     _DEV_MARKER = 0xFFFE
     _DEV_HDR = struct.Struct(">HI")
     _DEV_ITEM = struct.Struct(">iIQ")
+    # per-segment merged-location extension (push-based merge plane,
+    # shuffle/merge.py): written AFTER the device extension, BEFORE the
+    # trace extension. Same impossible-host-length marker trick with
+    # 0xFFFD and the same 6-byte (marker, count) header shape, so the
+    # single peek below disambiguates all three extensions. Layout:
+    # marker(2) count(4), then per location merged_cover(u4); cover 0 =
+    # a plain per-map block. Publishes with no merged location emit
+    # zero extension bytes — legacy frames stay byte-identical.
+    _MRG_MARKER = 0xFFFD
+    _MRG_HDR = struct.Struct(">HI")
+    _MRG_ITEM = struct.Struct(">I")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
@@ -144,6 +155,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
         has_dev = any(loc.block.arena_handle for loc in self.locations)
         dev_fixed = self._DEV_HDR.size if has_dev else 0
         dev_per_loc = self._DEV_ITEM.size if has_dev else 0
+        has_mrg = any(loc.block.merged_cover for loc in self.locations)
+        mrg_fixed = self._MRG_HDR.size if has_mrg else 0
+        mrg_per_loc = self._MRG_ITEM.size if has_mrg else 0
         budget = (
             seg_size
             - SEG_HEADER.size
@@ -151,13 +165,14 @@ class PublishPartitionLocationsMsg(RpcMsg):
             - self._TRACE_EXT.size
             - ck_fixed
             - dev_fixed
+            - mrg_fixed
         )
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
         groups: List[List[PartitionLocation]] = [[]]
         used = 0
         for loc in self.locations:
-            sz = loc.serialized_size() + ck_per_loc + dev_per_loc
+            sz = loc.serialized_size() + ck_per_loc + dev_per_loc + mrg_per_loc
             if sz > budget:
                 raise ValueError(
                     f"partition location ({sz} bytes) exceeds segment budget {budget}"
@@ -200,6 +215,12 @@ class PublishPartitionLocationsMsg(RpcMsg):
                             loc.block.arena_offset,
                         )
                     )
+            if has_mrg and group:
+                buf.write(self._MRG_HDR.pack(self._MRG_MARKER, len(group)))
+                for loc in group:
+                    buf.write(
+                        self._MRG_ITEM.pack(loc.block.merged_cover & 0xFFFFFFFF)
+                    )
             buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
@@ -215,8 +236,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
         # locations are each >= 28 bytes, so a residue of exactly 8 is
         # the trailing trace-id extension (absent from legacy senders);
         # a 0xFFFF two-byte peek is the checksum extension, a 0xFFFE
-        # peek the device-location extension — both sit between the
-        # locations and the trace id, in either order
+        # peek the device-location extension, a 0xFFFD peek the merged
+        # extension — all sit between the locations and the trace id,
+        # in any order
         while end - inp.tell() > cls._TRACE_EXT.size:
             pos = inp.tell()
             peek = inp.read(cls._CK_HDR.size)
@@ -259,6 +281,22 @@ class PublishPartitionLocationsMsg(RpcMsg):
                                 )
                     else:
                         inp.read(count * cls._DEV_ITEM.size)
+                    continue
+                if marker == cls._MRG_MARKER:
+                    if count == len(locs):
+                        for i in range(count):
+                            (cover,) = cls._MRG_ITEM.unpack(
+                                inp.read(cls._MRG_ITEM.size)
+                            )
+                            if cover:
+                                locs[i] = replace(
+                                    locs[i],
+                                    block=replace(
+                                        locs[i].block, merged_cover=cover
+                                    ),
+                                )
+                    else:
+                        inp.read(count * cls._MRG_ITEM.size)
                     continue
             inp.seek(pos)
             locs.append(PartitionLocation.read(inp))

@@ -334,6 +334,56 @@ class DeviceShuffleIO:
     # ------------------------------------------------------------------
     # reduce side: one-sided READ -> HBM slab
     # ------------------------------------------------------------------
+    def _apply_merged_plan(
+        self, locations: List[PartitionLocation], my_id: str
+    ) -> List[PartitionLocation]:
+        """Merged-else-original read selection (shuffle/merge.py).
+
+        A partition fully covered by a push-merged segment reads as ONE
+        sequential block instead of N per-map fetches. The device plane
+        only takes LOCAL merged segments (push routing lands them on
+        the reducing executor; a mis-routed segment just uses the
+        originals) and verifies them here — the local short-circuit in
+        the fetch loops skips the per-block checksum gate, and a
+        corrupted seal must detect and fall back, never surface."""
+        from sparkrdma_tpu.shuffle import merge as _merge
+
+        selected, fallbacks = _merge.plan_reads(locations)
+        if not fallbacks:
+            return selected
+        out: List[PartitionLocation] = []
+        for loc in selected:
+            if not loc.block.merged_cover:
+                out.append(loc)
+                continue
+            origs = fallbacks.get(loc.partition_id, [])
+            if loc.manager_id.executor_id != my_id:
+                out.extend(origs)
+                continue
+            try:
+                pd = self._manager.node.pd
+                view = pd.resolve(
+                    loc.block.mkey, loc.block.address, loc.block.length
+                )
+                if not _checksum.verify(
+                    view, loc.block.checksum, loc.block.checksum_algo
+                ):
+                    raise ValueError("merged segment checksum mismatch")
+            except Exception:
+                logger.warning(
+                    "merged segment for partition %d failed verification; "
+                    "reading originals", loc.partition_id,
+                )
+                get_registry().counter("push.fallbacks", role=my_id).inc()
+                get_registry().counter(
+                    "resilience.checksum_failures", role=my_id
+                ).inc()
+                out.extend(origs)
+                continue
+            get_registry().counter("reader.merged_reads", role=my_id).inc()
+            out.append(loc)
+        return out
+
     def fetch_device_blocks(
         self,
         shuffle_id: int,
@@ -395,6 +445,7 @@ class DeviceShuffleIO:
 
         out: Dict[int, List[DeviceBuffer]] = {}
         my_id = mgr.executor_id
+        locations = self._apply_merged_plan(locations, my_id)
         # Each in-flight read OWNS its destination buffer through its
         # completion listener: the buffer returns to the pool only once
         # the transport is provably done writing into it (completion or
@@ -624,6 +675,7 @@ class DeviceShuffleIO:
 
         out: Dict[int, List[HostBlock]] = {}
         my_id = mgr.executor_id
+        locations = self._apply_merged_plan(locations, my_id)
         pending: List[Optional[Tuple]] = []
         arrivals: "queue.Queue[int]" = queue.Queue()
         try:

@@ -47,6 +47,7 @@ from sparkrdma_tpu.memory.streams import MemoryviewInputStream
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.obs import now as obs_now
 from sparkrdma_tpu.resilience import CircuitOpenError, RetryPolicy
+from sparkrdma_tpu.shuffle import merge as _merge
 from sparkrdma_tpu.shuffle.errors import (
     ChecksumError,
     FetchFailedError,
@@ -69,14 +70,20 @@ class ShuffleMetrics:
     fetch_wait_ms: float = 0.0
     records_read: int = 0
     sort_spills: int = 0  # external-sorter runs spilled to scratch
+    merged_blocks: int = 0  # merged segments read in place of originals
 
 
 @dataclass
 class AggregatedPartitionGroup:
-    """Blocks from one source manager read in one one-sided READ (:71-74)."""
+    """Blocks from one source manager read in one one-sided READ (:71-74).
+
+    ``fallbacks`` rides only on groups carrying a MERGED segment
+    (shuffle/merge.py): the partition's suppressed original locations,
+    re-issued by ``_fallback_refetch`` if the merged read fails."""
 
     total_length: int = 0
     blocks: List[Tuple[int, BlockLocation]] = field(default_factory=list)  # (pid, loc)
+    fallbacks: Dict[int, List[PartitionLocation]] = field(default_factory=dict)
 
 
 @dataclass
@@ -143,6 +150,10 @@ class TpuShuffleFetcherIterator:
         self._m_failovers = reg.counter("resilience.failovers", role=role)
         self._m_splits = reg.counter("resilience.splits", role=role)
         self._m_fail_fast = reg.counter("resilience.circuit_fail_fast", role=role)
+        # push/merge plane: merged segments chosen over originals, and
+        # merged reads that degraded back to the originals
+        self._m_merged_reads = reg.counter("reader.merged_reads", role=role)
+        self._m_merged_fallbacks = reg.counter("push.fallbacks", role=role)
 
         self._results: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
@@ -188,6 +199,36 @@ class TpuShuffleFetcherIterator:
             len(locations),
             (time.monotonic() - t0) * 1e3,
         )
+
+        # merged-else-original (shuffle/merge.py): a partition whose
+        # merged segment covers ALL its originals is read as ONE
+        # sequential block; its originals stay attached as fallbacks
+        my_id = self._manager.executor_id
+        locations, merged_fallbacks = _merge.plan_reads(locations)
+        if merged_fallbacks:
+            self._m_merged_reads.inc(len(merged_fallbacks))
+            self.metrics.merged_blocks += len(merged_fallbacks)
+        merged_local = [
+            loc
+            for loc in locations
+            if loc.block.merged_cover and loc.manager_id.executor_id == my_id
+        ]
+        if merged_local:
+            locations = [loc for loc in locations if loc not in merged_local]
+        for loc in merged_local:
+            streams = self._read_local_merged(loc)
+            if streams is None:
+                # local merged segment unusable: restore the originals
+                # into the ordinary plan (locals short-circuit below)
+                locations.extend(merged_fallbacks.pop(loc.partition_id, ()))
+                continue
+            self.metrics.local_blocks += 1
+            self.metrics.local_bytes += loc.block.length
+            self._m_local_blocks.inc()
+            self._m_local_bytes.inc(loc.block.length)
+            with self._lock:
+                self._total_results += 1
+            self._put_success(streams, 0)
 
         # Local partitions short-circuit to streams (:328-339) — served
         # HERE, after the driver's barrier-gated reply, not at iterator
@@ -246,6 +287,8 @@ class TpuShuffleFetcherIterator:
                     group = AggregatedPartitionGroup()
                 group.blocks.append((pid, block))
                 group.total_length += block.length
+                if block.merged_cover and pid in merged_fallbacks:
+                    group.fallbacks[pid] = merged_fallbacks[pid]
             if group.blocks:
                 fetches.append(_PendingFetch(mid, group, deadline=deadline))
 
@@ -308,6 +351,12 @@ class TpuShuffleFetcherIterator:
         closed. Otherwise the retry is scheduled on a timer after the
         policy's deterministic backoff; no completion thread sleeps.
         """
+        if fetch.group.fallbacks:
+            # a merged-segment group: never walk the ladder — the
+            # merged-else-original contract's else branch re-issues the
+            # partition's original locations immediately
+            self._fallback_refetch(fetch, error)
+            return
         mid, group = fetch.manager_id, fetch.group
         failed_attempt = fetch.attempt
         retryable = not isinstance(error, CircuitOpenError)
@@ -437,6 +486,113 @@ class TpuShuffleFetcherIterator:
         for sub in subs:
             self._fetch_blocks(sub)
 
+    def _read_local_merged(self, loc: PartitionLocation):
+        """Serve a merged segment sealed on THIS executor: resolve the
+        registered bytes directly — and verify the publish-time
+        checksum HERE, because the local path bypasses the remote
+        READ's checksum gate and a corrupted merged segment must fall
+        back to the originals, never reach the deserializer. Returns
+        the (pid, stream) list, or None to fall back."""
+        block = loc.block
+        try:
+            view = self._manager.node.pd.resolve(
+                block.mkey, block.address, block.length
+            )
+            if not _checksum.verify(view, block.checksum, block.checksum_algo):
+                raise ChecksumError(
+                    self._handle.shuffle_id,
+                    loc.partition_id,
+                    f"merged segment of {block.length} bytes (local)",
+                )
+        except Exception as e:
+            self._m_checksum_failures.inc()
+            self._m_merged_fallbacks.inc()
+            logger.warning(
+                "local merged segment for pid %d unusable (%s); "
+                "falling back to originals",
+                loc.partition_id,
+                e,
+            )
+            return None
+        return [(loc.partition_id, MemoryviewInputStream(view))]
+
+    def _fallback_refetch(self, fetch: _PendingFetch, error: Exception) -> None:
+        """A merged-segment read failed (checksum mismatch, dead peer,
+        dropped buffer): re-issue the partitions' ORIGINAL per-map
+        locations, kept attached as the group's fallbacks — the
+        merged-else-original contract's else branch. Accounting mirrors
+        ``_split_and_refetch``: the parent result slot is replaced by
+        the replacements' and their in_flight shares sum to the
+        parent's total (a merged segment's length equals the sum of
+        its originals')."""
+        group = fetch.group
+        self._m_merged_fallbacks.inc()
+        logger.info(
+            "merged read from %s failed (%s); falling back to originals "
+            "for %d partition(s)",
+            fetch.manager_id.executor_id,
+            error,
+            len(group.fallbacks),
+        )
+        my_id = self._manager.executor_id
+        resolver = self._manager.resolver
+        local_streams: List[Tuple[int, BinaryIO]] = []
+        served_local = set()
+        by_manager: Dict[ShuffleManagerId, List[Tuple[int, BlockLocation]]] = {}
+        for pid, block in group.blocks:
+            originals = group.fallbacks.get(pid) if block.merged_cover else None
+            if originals is None:
+                # non-merged groupmate: re-fetch as-is from the source
+                by_manager.setdefault(fetch.manager_id, []).append((pid, block))
+                continue
+            for loc in originals:
+                if loc.manager_id.executor_id == my_id:
+                    if pid not in served_local:
+                        served_local.add(pid)
+                        for stream in resolver.get_local_partition_streams(
+                            self._handle.shuffle_id, pid
+                        ):
+                            local_streams.append((pid, stream))
+                else:
+                    by_manager.setdefault(loc.manager_id, []).append(
+                        (pid, loc.block)
+                    )
+        read_block_size = self._manager.conf.shuffle_read_block_size
+        subs: List[_PendingFetch] = []
+        for mid, blocks in by_manager.items():
+            g = AggregatedPartitionGroup()
+            for pid, block in blocks:
+                if g.blocks and g.total_length + block.length > read_block_size:
+                    subs.append(_PendingFetch(mid, g, deadline=fetch.deadline))
+                    g = AggregatedPartitionGroup()
+                g.blocks.append((pid, block))
+                g.total_length += block.length
+            if g.blocks:
+                subs.append(_PendingFetch(mid, g, deadline=fetch.deadline))
+        remote_sum = sum(s.group.total_length for s in subs)
+        local_share = max(0, group.total_length - remote_sum)
+        put_local = bool(local_streams) or local_share > 0 or not subs
+        n_new = len(subs) + (1 if put_local else 0)
+        with self._lock:
+            closed = self._closed
+            if not closed:
+                self._total_results += n_new - 1
+        if closed:
+            for _pid, stream in local_streams:
+                try:
+                    stream.close()
+                except Exception:
+                    logger.exception("closing fallback stream failed")
+            return
+        if put_local:
+            self.metrics.local_blocks += len(local_streams)
+            self.metrics.local_bytes += local_share
+            self._m_local_blocks.inc(len(local_streams))
+            self._m_local_bytes.inc(local_share)
+            self._put_success(local_streams, local_share)
+        for sub in subs:
+            self._fetch_blocks(sub)
+
     def _bad_block(self, group: AggregatedPartitionGroup, views) -> Optional[int]:
         """Index of the first checksum-mismatched block, else None."""
         for i, ((_pid, block), view) in enumerate(zip(group.blocks, views)):
@@ -478,12 +634,15 @@ class TpuShuffleFetcherIterator:
             # the fail-fast decision for a peer presumed dead, so this
             # surfaces immediately as a FetchFailedError / recompute
             self._m_fail_fast.inc()
-            self._surface_failure(
-                fetch,
-                CircuitOpenError(
-                    f"circuit to {mid.executor_id} is open (peer unhealthy)"
-                ),
+            err = CircuitOpenError(
+                f"circuit to {mid.executor_id} is open (peer unhealthy)"
             )
+            if group.fallbacks:
+                # merged segment behind an open circuit: its originals
+                # (on other, possibly healthy peers) are the answer
+                self._fallback_refetch(fetch, err)
+                return
+            self._surface_failure(fetch, err)
             return
         t0 = obs_now()
         try:

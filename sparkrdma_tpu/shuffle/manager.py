@@ -161,6 +161,23 @@ class TpuShuffleManager:
 
         self.resolver = TpuShuffleBlockResolver(self)
 
+        # push/merge plane (shuffle/merge.py): every manager hosts a
+        # merge endpoint (receiving pushed blocks for partitions it
+        # will reduce) and a push client (shipping its own sealed map
+        # blocks toward their reducers). Both are strictly best-effort
+        # overlays on the locations API — disabling them changes
+        # nothing but read amplification.
+        self.push_client = None
+        self.merge_endpoint = None
+        if conf.push_enabled:
+            from sparkrdma_tpu.shuffle import merge as _merge
+
+            self.push_client = _merge.PushClient(self)
+            self.merge_endpoint = _merge.MergeEndpoint(self)
+            _merge.register_endpoint(self.merge_endpoint)
+        # publish-time checksum tagging pool (lazy; see _checksummed)
+        self._ck_pool: Optional[ThreadPoolExecutor] = None
+
     # ------------------------------------------------------------------
     # node lifecycle
     # ------------------------------------------------------------------
@@ -358,6 +375,15 @@ class TpuShuffleManager:
                         by_exec[exec_id] = by_exec.get(exec_id, 0) + msg.num_map_outputs
                     if handle is not None and done >= handle.num_maps:
                         to_reply = self._deferred_fetches.pop(msg.shuffle_id, [])
+            # feed the adaptive planner: per-partition byte totals of
+            # ORIGINAL locations (merged segments re-cover the same
+            # bytes and would double-count)
+            if self.telemetry is not None and msg.partition_id < 0:
+                for loc in msg.locations:
+                    if not loc.block.merged_cover:
+                        self.telemetry.record_partition_bytes(
+                            msg.shuffle_id, loc.partition_id, loc.block.length
+                        )
             for fetch in to_reply:
                 self._reply_fetch(fetch)
             return
@@ -434,6 +460,36 @@ class TpuShuffleManager:
             return loc
         return replace(loc, block=replace(loc.block, checksum=crc, checksum_algo=algo))
 
+    def _checksummed(
+        self, locations: List[PartitionLocation]
+    ) -> List[PartitionLocation]:
+        """Tag a publish batch, sharding the checksum compute across a
+        small pool for large batches (conf ``publish.checksumWorkers``;
+        0/1 = inline). The contended-publish ledger rows showed the
+        tagging loop dominating publish busy time when every executor's
+        finalize lands at once — order is preserved, tagging stays the
+        single funnel of :meth:`_with_checksum`."""
+        workers = self.conf.publish_checksum_workers
+        if workers <= 1 or len(locations) < 4 * workers:
+            return [self._with_checksum(loc) for loc in locations]
+        with self._lock:
+            if self._ck_pool is None:
+                self._ck_pool = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix=f"ck-{self.executor_id}",
+                )
+            pool = self._ck_pool
+        chunk = (len(locations) + workers - 1) // workers
+        parts = [locations[i : i + chunk] for i in range(0, len(locations), chunk)]
+        futs = [
+            pool.submit(lambda ls=ls: [self._with_checksum(l) for l in ls])
+            for ls in parts
+        ]
+        out: List[PartitionLocation] = []
+        for f in futs:
+            out.extend(f.result())
+        return out
+
     def publish_partition_locations(
         self,
         shuffle_id: int,
@@ -442,7 +498,7 @@ class TpuShuffleManager:
         num_map_outputs: int = 0,
     ) -> None:
         if self.conf.resilience_checksums:
-            locations = [self._with_checksum(loc) for loc in locations]
+            locations = self._checksummed(locations)
         msg = PublishPartitionLocationsMsg(
             shuffle_id,
             partition_id,
@@ -583,7 +639,44 @@ class TpuShuffleManager:
         if isinstance(data, ChunkedAggShuffleData):
             data.finalize_and_publish(self)
 
+    def known_executor_ids(self) -> List[str]:
+        """Executor ids this manager can name as push destinations:
+        announced membership plus itself (executors only — the driver
+        never reduces)."""
+        with self._lock:
+            ids = {m.executor_id for m in self._known_managers}
+            ids.update(self._manager_ids.keys())
+        if not self.is_driver:
+            ids.add(self.executor_id)
+        return sorted(ids)
+
+    def partition_sizes(self, shuffle_id: int) -> Dict[int, int]:
+        """Driver: published per-partition byte totals (original
+        locations only — merged segments re-cover the same bytes). The
+        adaptive partition planner's input; prefers the telemetry
+        hub's running totals, falls back to the location registry."""
+        if self.telemetry is not None:
+            sizes = self.telemetry.partition_bytes(shuffle_id)
+            if sizes:
+                return sizes
+        out: Dict[int, int] = {}
+        with self._shuffle_lock(shuffle_id):
+            with self._lock:
+                shuffle = self._partition_locations.get(shuffle_id)
+            if shuffle:
+                for pid, locs in shuffle.items():
+                    out[pid] = sum(
+                        loc.block.length
+                        for loc in locs
+                        if not loc.block.merged_cover
+                    )
+        return out
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
+        if self.merge_endpoint is not None:
+            self.merge_endpoint.drop_shuffle(shuffle_id)
+        if self.telemetry is not None:
+            self.telemetry.drop_partition_bytes(shuffle_id)
         self.resolver.remove_shuffle(shuffle_id)
         with self._lock:
             self._partition_locations.pop(shuffle_id, None)
@@ -661,8 +754,16 @@ class TpuShuffleManager:
                 return
             self._stopped = True
             map_pool, self._map_pool = self._map_pool, None
+            ck_pool, self._ck_pool = self._ck_pool, None
         if map_pool is not None:
             map_pool.shutdown(wait=True)
+        if ck_pool is not None:
+            ck_pool.shutdown(wait=True)
+        if self.merge_endpoint is not None:
+            from sparkrdma_tpu.shuffle import merge as _merge
+
+            _merge.unregister_endpoint(self.merge_endpoint)
+            self.merge_endpoint.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.reader_stats is not None:

@@ -81,7 +81,12 @@ class TpuShuffleBlockResolver:
                 if self.conf.shuffle_writer_method == ShuffleWriterMethod.WRAPPER:
                     data = WrapperShuffleData(self, handle.shuffle_id, handle.num_partitions)
                 else:
-                    data = ChunkedAggShuffleData(self, handle.shuffle_id, handle.num_partitions)
+                    data = ChunkedAggShuffleData(
+                        self,
+                        handle.shuffle_id,
+                        handle.num_partitions,
+                        num_maps=handle.num_maps,
+                    )
                 self._data[handle.shuffle_id] = data
             return data
 

@@ -51,10 +51,11 @@ _LEN = struct.Struct(">I")
 
 
 class ChunkedAggShuffleData(ShuffleData):
-    def __init__(self, resolver, shuffle_id: int, num_partitions: int):
+    def __init__(self, resolver, shuffle_id: int, num_partitions: int, num_maps: int = 0):
         self._resolver = resolver
         self.shuffle_id = shuffle_id
         self.num_partitions = num_partitions
+        self.num_maps = num_maps
         self._writers: Dict[int, PartitionWriter] = {}
         self._lock = threading.Lock()
         self._active_shuffle_writers = 0
@@ -68,6 +69,12 @@ class ChunkedAggShuffleData(ShuffleData):
             getattr(resolver.conf, "map_incremental_publish", False)
         )
         self._sealed_published: Dict[int, int] = {}
+        # push/merge plane (shuffle/merge.py): independent per-pid
+        # cursors so sealed blocks push toward their reducer whether or
+        # not incremental publish is on; seq is a dense per-pid counter
+        # assigned under the lock so concurrent commits keep block order
+        self._push_cursor: Dict[int, int] = {}
+        self._push_seq: Dict[int, int] = {}
 
     def partition_writer(self, pid: int) -> PartitionWriter:
         with self._lock:
@@ -114,22 +121,72 @@ class ChunkedAggShuffleData(ShuffleData):
                     if sealed > cursor:
                         window.append((pid, pw, cursor, sealed))
                         self._sealed_published[pid] = sealed
-        if not window:
-            return
-        locs: List[PartitionLocation] = []
-        for pid, pw, start, end in window:
-            for block_loc in pw.locations_range(start, end):
-                locs.append(
-                    PartitionLocation(manager.local_manager_id, pid, block_loc)
+            push_blocks = self._collect_push_locked(manager)
+        if window:
+            locs: List[PartitionLocation] = []
+            for pid, pw, start, end in window:
+                for block_loc in pw.locations_range(start, end):
+                    locs.append(
+                        PartitionLocation(manager.local_manager_id, pid, block_loc)
+                    )
+            if locs:
+                get_registry().counter(
+                    "writer.incremental_publishes", role=manager.executor_id
+                ).inc()
+                manager.publish_partition_locations(
+                    self.shuffle_id, -1, locs, num_map_outputs=0
                 )
-        if not locs:
+        if push_blocks:
+            self._push_blocks(manager, push_blocks)
+
+    def _collect_push_locked(self, manager, tail: bool = False) -> List:
+        """Under ``self._lock``: advance the push cursors over newly
+        sealed blocks (ALL remaining blocks when ``tail``, at finalize)
+        and assign each a dense per-pid seq — order fixed here, under
+        the lock, so concurrent map commits cannot interleave seqs out
+        of block order. Payload resolution happens later, outside."""
+        if (
+            manager is None
+            or getattr(manager, "push_client", None) is None
+            or self._poisoned
+            or self._published and not tail
+        ):
+            return []
+        out = []
+        for pid, pw in self._writers.items():
+            sealed = (1 << 30) if tail else pw.sealed_count()
+            cursor = self._push_cursor.get(pid, 0)
+            if sealed <= cursor:
+                continue
+            blocks = pw.locations_range(cursor, sealed)
+            self._push_cursor[pid] = cursor + len(blocks) if tail else sealed
+            for bl in blocks:
+                seq = self._push_seq.get(pid, 0)
+                self._push_seq[pid] = seq + 1
+                out.append((pid, seq, bl))
+        return out
+
+    def _push_blocks(self, manager, blocks, final=None) -> None:
+        """Resolve block payloads and hand them to the push client.
+        Best-effort by design: any failure here is logged and dropped —
+        the original locations stay authoritative."""
+        client = getattr(manager, "push_client", None)
+        if client is None or (not blocks and final is None):
             return
-        get_registry().counter(
-            "writer.incremental_publishes", role=manager.executor_id
-        ).inc()
-        manager.publish_partition_locations(
-            self.shuffle_id, -1, locs, num_map_outputs=0
-        )
+        try:
+            manager.start_node_if_missing()
+            pd = manager.node.pd
+            payloads = [
+                (pid, seq, bytes(pd.resolve(bl.mkey, bl.address, bl.length)))
+                for pid, seq, bl in blocks
+            ]
+            client.push_window(
+                self.shuffle_id, payloads, self.num_partitions, final=final
+            )
+        except Exception:
+            logger.debug(
+                "push window for shuffle %d failed", self.shuffle_id, exc_info=True
+            )
 
     def abort_map_output(self, dirty: bool = False) -> None:
         """A map task failed: it must NOT count toward the driver's
@@ -177,6 +234,21 @@ class ChunkedAggShuffleData(ShuffleData):
             writers = dict(self._writers)
             committed = self._committed_maps
             cursors = dict(self._sealed_published)
+            push_blocks = self._collect_push_locked(manager, tail=True)
+            push_final = None
+            if getattr(manager, "push_client", None) is not None:
+                push_final = {
+                    "counts": {p: n for p, n in self._push_seq.items() if n},
+                    "committed": committed,
+                    "num_maps": self.num_maps,
+                }
+        # push the remainder plus the final coverage marker BEFORE the
+        # barrier-completing publish below: merge endpoints seal and
+        # publish their merged segments inside this synchronous call,
+        # so merged locations reach the driver ahead of any deferred
+        # fetch reply the barrier releases
+        if push_final is not None:
+            self._push_blocks(manager, push_blocks, final=push_final)
         # publish everything past each pid's incremental cursor (all of
         # it when incremental mode is off — cursors are then empty); the
         # full map-output count rides THIS message, completing the

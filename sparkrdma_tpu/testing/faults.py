@@ -12,6 +12,11 @@ seeded, config-driven *fault plan* hooked at four seams:
     ``DeviceShuffleIO.stage_host_block`` = ``stage=stage``): corrupt a
     block AFTER the wire delivered it intact, proving the decode-stage
     checksum gate catches what the transport-level gates cannot see
+  - ``push``  — the push/merge plane (shuffle/merge.py): ``drop`` /
+    ``fail`` / ``delay`` fire at the client's send phase (lost push →
+    originals stay authoritative); ``corrupt`` fires at the endpoint's
+    seal phase AFTER the merged checksum tag (reduce path must detect
+    and fall back)
 
 Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
 ``delay`` (sleep ``delay_ms`` then proceed), ``corrupt`` (flip one
@@ -46,7 +51,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-OPS = ("read", "send", "rpc", "stage")
+OPS = ("read", "send", "rpc", "stage", "push")
 KINDS = ("fail", "delay", "corrupt", "drop")
 
 
@@ -136,13 +141,18 @@ class FaultPlan:
             )
 
     def _match(
-        self, op: str, peer: str, stage: str = ""
+        self, op: str, peer: str, stage: str = "", kinds: Sequence[str] = ()
     ) -> Optional[Tuple[FaultRule, int]]:
         """First applicable rule for this op, or None. Decrements its
-        budget and returns (rule, global fire index) when it fires."""
+        budget and returns (rule, global fire index) when it fires.
+        ``kinds`` restricts matching to those fault kinds — seams with
+        several phases (push send vs seal) use it so a rule for the
+        other phase neither fires nor burns budget here."""
         with self._lock:
             for i, rule in enumerate(self.rules):
                 if rule.op != op:
+                    continue
+                if kinds and rule.kind not in kinds:
                     continue
                 if rule.peer and rule.peer not in peer:
                     continue
@@ -275,6 +285,40 @@ class FaultPlan:
                     self._flip_byte(v, fire_index)
                     return
         raise InjectedFault(f"injected {rule.kind} in pipeline stage {stage}")
+
+    def on_push(self, phase: str, views, peer: str = "") -> bool:
+        """Push-plane seam (shuffle/merge.py), two phases:
+
+        - ``send`` (PushClient, before transmission): ``drop``/``fail``
+          return True — the push message is silently lost, the merge
+          endpoint's coverage stays incomplete and the reduce path
+          keeps the original per-map locations; ``delay`` stalls then
+          proceeds. ``push:drop:N`` is the canonical lost-push plan.
+        - ``seal`` (MergeEndpoint, AFTER the merged segment's checksum
+          was computed): ``corrupt`` flips one byte of the sealed
+          segment in place, the adversary the reduce path's ordinary
+          checksum gate must catch and answer with a fallback to the
+          originals. ``push:corrupt:1`` is the canonical plan.
+
+        Each phase matches only its own kinds, so a ``push:corrupt``
+        rule never burns budget at the send phase and vice versa.
+        Returns True when the push must be dropped (send phase only)."""
+        kinds = ("corrupt",) if phase == "seal" else ("fail", "delay", "drop")
+        hit = self._match("push", peer, kinds=kinds)
+        if hit is None:
+            return False
+        rule, fire_index = hit
+        logger.info("fault plan: %s push (%s phase) peer=%s", rule.kind, phase, peer)
+        if rule.kind == "delay":
+            time.sleep(rule.delay_ms / 1000.0)
+            return False
+        if rule.kind == "corrupt":
+            for v in views or ():
+                if len(v) and not getattr(v, "readonly", True):
+                    self._flip_byte(v, fire_index)
+                    break
+            return False
+        return True  # fail/drop: lost push
 
 
 def _drop_channel(channel) -> None:

@@ -377,6 +377,57 @@ class TpuShuffleConf:
         stage and merge on one thread."""
         return self._bool("reduce.doubleBufferStaging", True)
 
+    # -- push-based merge plane (shuffle/merge.py; DESIGN.md §18) ---------
+    @property
+    def push_enabled(self) -> bool:
+        """Push sealed chunked-agg writer blocks toward their reducer's
+        executor as maps commit; complete pid coverage seals into ONE
+        merged segment the reduce path prefers over N per-map fetches.
+        Best-effort everywhere: a dropped/late/over-budget push just
+        leaves the original per-map locations authoritative."""
+        return self._bool("push.enabled", True)
+
+    @property
+    def push_max_buffer_bytes(self) -> int:
+        """Per-executor budget for buffered pushed-but-unsealed block
+        payloads in its MergeEndpoint. A push that would exceed it is
+        dropped (its partition falls back to original locations)."""
+        return self._bytes("push.maxBufferBytes", "256m", 1 << 16, 1 << 40)
+
+    @property
+    def publish_checksum_workers(self) -> int:
+        """Shard ``publish_partition_locations``' checksum/validation
+        work across a small pool when a publish carries at least
+        2x this many locations; 0 computes inline on the publishing
+        thread (the pre-PR-7 behavior)."""
+        return self._int("publish.checksumWorkers", 4, 0, 32)
+
+    # -- adaptive partition planner (shuffle/planner.py) ------------------
+    @property
+    def planner_enabled(self) -> bool:
+        """Re-plan reduce partition ranges from the map stage's
+        per-partition byte statistics before reduce launch: hot
+        partitions are isolated (splits), tiny neighbors coalesced —
+        contiguous-range rule, so ordering workloads stay correct."""
+        return self._bool("planner.enabled", True)
+
+    @property
+    def planner_hot_factor(self) -> float:
+        """A partition is *hot* (isolated into its own reduce range)
+        when its bytes exceed this multiple of the mean reducer load."""
+        raw = self._conf.get(PREFIX + "planner.hotFactor")
+        try:
+            v = float(raw) if raw is not None else 1.5
+        except ValueError:
+            v = 1.5
+        return v if 1.0 <= v <= 100.0 else 1.5
+
+    @property
+    def planner_sample_size(self) -> int:
+        """Keys sampled per shard for the device planner's quantile
+        edges (models/terasort.py adaptive sort)."""
+        return self._int("planner.sampleSize", 4096, 64, 1 << 24)
+
     # -- reduce-side ordering ---------------------------------------------
     @property
     def sort_spill_threshold(self) -> int:

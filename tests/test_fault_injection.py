@@ -477,3 +477,95 @@ def test_circuit_breaker_opens_and_fails_fast():
         reg.delta(before, prefix="resilience.circuit_fail_fast")
     )
     assert fail_fast >= 1, "expected at least one circuit-open fail-fast"
+
+
+# ----------------------------------------------------------------------
+# push/merge plane seams (shuffle/merge.py, DESIGN.md §18)
+# ----------------------------------------------------------------------
+def _chunked_push_shuffle(push_on=True):
+    """One 2-executor chunked-agg shuffle (the writer method carrying
+    the push hooks); returns the reduce output as sorted (k, v) pairs
+    so runs are comparable byte-for-byte at the record level."""
+    from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "chunkedpartitionagg",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleReadBlockSize": "65536",
+            "tpu.shuffle.push.enabled": "true" if push_on else "false",
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="pfi-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="pfi-1")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=4, partitioner=HashPartitioner(5)
+        )
+        driver.register_shuffle(handle)
+        for map_id, ex in [(0, ex0), (1, ex0), (2, ex1), (3, ex1)]:
+            w = ex.get_writer(handle, map_id)
+            w.write(
+                iter(
+                    (f"key-{(map_id * 3000 + i) % 397}", map_id * 3000 + i)
+                    for i in range(3000)
+                )
+            )
+            assert w.stop(True) is not None
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+        out = []
+        for ex, (lo, hi) in [(ex0, (0, 3)), (ex1, (3, 5))]:
+            reader = ex.get_reader(handle, lo, hi)
+            out.extend(reader.read())
+        return sorted(out)
+    finally:
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
+def test_push_drop_falls_back_to_originals_byte_identical():
+    """ISSUE acceptance (`push:drop:N`): lost push messages leave the
+    affected partitions' coverage incomplete — no seal, originals stay
+    authoritative, and the shuffle output is exactly the non-push
+    run's output. Best-effort means a drop is never an error."""
+    from sparkrdma_tpu.testing import faults
+
+    baseline = _chunked_push_shuffle(push_on=False)
+    with faults.installed("push:drop:3") as plan:
+        out = _chunked_push_shuffle(push_on=True)
+    assert plan.injected_count("push", "drop") == 3, (
+        "the drop seam never fired — pushes did not flow"
+    )
+    assert out == baseline
+
+
+def test_push_corrupt_merged_segment_detected_then_fallback():
+    """ISSUE acceptance (`push:corrupt:1`): a merged segment corrupted
+    AFTER its checksum tag was computed must be caught by the reduce
+    path's ordinary checksum gate and answered with a fallback to the
+    original per-map blocks — detect -> fallback -> byte-identical
+    output, with the detection and fallback counters as proof."""
+    from sparkrdma_tpu.testing import faults
+
+    reg = get_registry()
+    baseline = _chunked_push_shuffle(push_on=False)
+    before_detect = reg.snapshot(prefix="resilience.checksum_failures")
+    before_fallback = reg.snapshot(prefix="push.fallbacks")
+    with faults.installed("push:corrupt:1", seed=13) as plan:
+        out = _chunked_push_shuffle(push_on=True)
+    assert plan.injected_count("push", "corrupt") == 1, (
+        "the seal-corruption seam never fired — no segment sealed"
+    )
+    assert out == baseline
+    detected = _counter_total(
+        reg.delta(before_detect, prefix="resilience.checksum_failures")
+    )
+    fallbacks = _counter_total(
+        reg.delta(before_fallback, prefix="push.fallbacks")
+    )
+    assert detected >= 1, "corruption fired but the checksum gate missed it"
+    assert fallbacks >= 1, "detection without a fallback to the originals"

@@ -1,0 +1,333 @@
+"""Push-based merged shuffle (shuffle/merge.py, DESIGN.md §18).
+
+The plane is strictly best-effort behind the resolver/locations API:
+map-side sealed blocks push toward their reducer's executor, complete
+coverage seals ONE merged segment per partition, and the reduce
+planner reads merged-else-original — never both, never neither. These
+tests pin the contract at three layers: the read-planning rule, the
+endpoint's dedup/budget/seal accounting, the wire extension's legacy
+byte-identity, and the manager-level e2e where the reduce side's
+per-partition reads collapse to one merged read each."""
+
+import threading
+
+import pytest
+
+from sparkrdma_tpu.locations import (
+    BlockLocation,
+    PartitionLocation,
+    ShuffleManagerId,
+)
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.rpc import PublishPartitionLocationsMsg, RpcMsg
+from sparkrdma_tpu.shuffle import merge
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+def _loc(pid, length=64, mkey=1, executor="exec-0", cover=0):
+    return PartitionLocation(
+        ShuffleManagerId("host", 1234, executor),
+        pid,
+        BlockLocation(0, length, mkey, merged_cover=cover),
+    )
+
+
+def _counter_total(delta, needle):
+    return sum(
+        v for k, v in delta.get("counters", {}).items() if needle in k
+    )
+
+
+# ----------------------------------------------------------------------
+# plan_reads: the merged-else-original rule
+# ----------------------------------------------------------------------
+def test_plan_reads_prefers_full_coverage_merged():
+    origs = [_loc(0, mkey=i) for i in range(1, 4)]
+    merged_loc = _loc(0, length=192, mkey=9, executor="exec-1", cover=3)
+    selected, fallbacks = merge.plan_reads(origs + [merged_loc])
+    assert selected == [merged_loc]
+    assert fallbacks == {0: origs}
+
+
+def test_plan_reads_partial_coverage_keeps_originals():
+    """A merged segment covering fewer (or more) blocks than the
+    partition actually published is NEVER selected — a dropped push or
+    a duplicate publish silently leaves the originals authoritative."""
+    origs = [_loc(0, mkey=i) for i in range(1, 4)]
+    for cover in (1, 2, 4):
+        stale = _loc(0, mkey=9, cover=cover)
+        selected, fallbacks = merge.plan_reads(origs + [stale])
+        assert selected == origs
+        assert fallbacks == {}
+    # merged with NO originals at all: nothing to substitute for
+    alone = _loc(5, mkey=9, cover=2)
+    selected, fallbacks = merge.plan_reads([alone] + origs)
+    assert selected == origs
+    assert fallbacks == {}
+
+
+def test_plan_reads_mixed_partitions_independent():
+    """Partition selection is independent: pid 0 reads merged, pid 1
+    (no merged candidate) reads originals, pid 2's partial-coverage
+    candidate is dropped."""
+    o0 = [_loc(0, mkey=i) for i in (1, 2)]
+    o1 = [_loc(1, mkey=3)]
+    o2 = [_loc(2, mkey=i) for i in (4, 5)]
+    m0 = _loc(0, mkey=10, cover=2)
+    m2 = _loc(2, mkey=11, cover=1)  # stale
+    selected, fallbacks = merge.plan_reads(o0 + o1 + o2 + [m0, m2])
+    assert selected == [m0] + o1 + o2
+    assert fallbacks == {0: o0}
+
+
+# ----------------------------------------------------------------------
+# wire: trailing merged-cover extension (marker 0xFFFD)
+# ----------------------------------------------------------------------
+def test_publish_msg_merged_ext_roundtrip_and_legacy_identity():
+    """merged_cover rides the frame and survives parsing; frames with
+    NO merged locations are byte-identical to the pre-extension layout
+    (the feature-off acceptance bar)."""
+    locs = [_loc(0, mkey=3), _loc(1, mkey=4)]
+    merged_locs = locs + [_loc(2, length=128, mkey=9, cover=2)]
+    msg = PublishPartitionLocationsMsg(7, -1, merged_locs)
+    (seg,) = msg.to_segments(4096)
+    parsed = RpcMsg.parse_segment(seg)
+    assert [l.block.merged_cover for l in parsed.locations] == [0, 0, 2]
+
+    # legacy byte-identity: cover-0-only frames carry ZERO extension bytes
+    plain = PublishPartitionLocationsMsg(7, -1, locs)
+    baseline = PublishPartitionLocationsMsg(
+        7, -1,
+        [
+            PartitionLocation(
+                l.manager_id, l.partition_id,
+                BlockLocation(l.block.address, l.block.length, l.block.mkey),
+            )
+            for l in locs
+        ],
+    )
+    assert plain.to_segments(4096) == baseline.to_segments(4096)
+
+
+def test_publish_msg_merged_ext_survives_segmentation():
+    locs = [
+        _loc(i, length=32 + i, mkey=100 + i, cover=(i % 3))
+        for i in range(30)
+    ]
+    msg = PublishPartitionLocationsMsg(9, -1, locs)
+    segments = msg.to_segments(256)
+    assert len(segments) > 1
+    got = []
+    for seg in segments:
+        got.extend(RpcMsg.parse_segment(seg).locations)
+    for i, l in enumerate(sorted(got, key=lambda x: x.partition_id)):
+        assert l.block.merged_cover == i % 3
+
+
+# ----------------------------------------------------------------------
+# endpoint: dedup, budget, complete-coverage sealing
+# ----------------------------------------------------------------------
+def test_merge_endpoint_dedup_and_coverage_seal():
+    reg = get_registry()
+    conf = TpuShuffleConf()
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex = TpuShuffleManager(conf, is_driver=False, executor_id="mep-0")
+    try:
+        ep = ex.merge_endpoint
+        assert ep is not None  # push is on by default
+        before = reg.snapshot(prefix="push.")
+        handle = BaseShuffleHandle(
+            shuffle_id=31, num_maps=2, partitioner=HashPartitioner(1)
+        )
+        driver.register_shuffle(handle)
+        # two sources, one pid; duplicate delivery of (src-a, 0) dedups
+        ep.push_blocks(31, "src-a", [(0, 0, b"aaaa")])
+        ep.push_blocks(31, "src-a", [(0, 0, b"aaaa")])  # dup
+        ep.push_blocks(
+            31, "src-a", [], final={"counts": {0: 1}, "committed": 1,
+                                    "num_maps": 2}
+        )
+        # not sealed yet: src-b's marker is missing
+        delta = reg.delta(before, prefix="push.")
+        assert _counter_total(delta, "merge_segments") == 0
+        assert _counter_total(delta, "dedup_drops") == 1
+        ep.push_blocks(
+            31, "src-b", [(0, 0, b"bbbb")],
+            final={"counts": {0: 1}, "committed": 1, "num_maps": 2},
+        )
+        delta = reg.delta(before, prefix="push.")
+        assert _counter_total(delta, "merge_segments") == 1
+        # the sealed segment registered with the driver as a location
+        # carrying merged_cover == 2, alongside nothing else (no map
+        # outputs were published in this synthetic setup)
+        # read the driver registry directly: a location-only merged
+        # publish never advances the map-output barrier, so a real
+        # fetch would (correctly) block until maps also published —
+        # and the executor's publish RPC lands asynchronously
+        import time as _time
+
+        merged_locs = []
+        deadline = _time.time() + 10
+        while _time.time() < deadline and not merged_locs:
+            locs = driver._partition_locations.get(31, {}).get(0, [])
+            merged_locs = [l for l in locs if l.block.merged_cover]
+            if not merged_locs:
+                _time.sleep(0.05)
+        assert len(merged_locs) == 1
+        assert merged_locs[0].block.merged_cover == 2
+        assert merged_locs[0].block.length == 8
+        # payload order: sources sorted naturally, then seq
+        view = ex.node.pd.resolve(
+            merged_locs[0].block.mkey, 0, merged_locs[0].block.length
+        )
+        assert bytes(view) == b"aaaabbbb"
+    finally:
+        ex.stop()
+        driver.stop()
+
+
+def test_merge_endpoint_budget_drop_falls_back():
+    """A partition blowing the buffer budget is abandoned — counted,
+    never sealed, and late blocks for it dedup-drop."""
+    reg = get_registry()
+    # 64 KiB is the knob's floor; two ~40 KB pushes blow it
+    conf = TpuShuffleConf({"tpu.shuffle.push.maxBufferBytes": "65536"})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex = TpuShuffleManager(conf, is_driver=False, executor_id="mep-1")
+    try:
+        ep = ex.merge_endpoint
+        before = reg.snapshot(prefix="push.")
+        handle = BaseShuffleHandle(
+            shuffle_id=32, num_maps=1, partitioner=HashPartitioner(1)
+        )
+        driver.register_shuffle(handle)
+        ep.push_blocks(32, "src-a", [(0, 0, b"x" * 40_000)])
+        ep.push_blocks(32, "src-a", [(0, 1, b"y" * 40_000)])  # blows budget
+        ep.push_blocks(
+            32, "src-a", [],
+            final={"counts": {0: 2}, "committed": 1, "num_maps": 1},
+        )
+        delta = reg.delta(before, prefix="push.")
+        assert _counter_total(delta, "budget_drops") >= 1
+        assert _counter_total(delta, "merge_segments") == 0
+        locs = driver._partition_locations.get(32, {}).get(0, [])
+        assert not [l for l in locs if l.block.merged_cover]
+    finally:
+        ex.stop()
+        driver.stop()
+
+
+# ----------------------------------------------------------------------
+# e2e: chunked-agg writer pushes, reduce reads merged segments
+# ----------------------------------------------------------------------
+def test_push_e2e_reduce_reads_one_merged_segment_per_partition():
+    """Full manager-level shuffle with the chunked-agg writer: every
+    partition seals a merged segment and the reduce side issues exactly
+    R merged reads (`reader.merged_reads` == partitions read) — the
+    M*R -> R sequential-read collapse, proven via metrics; output
+    matches the expected aggregation exactly."""
+    num_partitions = 5
+    reg = get_registry()
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "chunkedpartitionagg",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleReadBlockSize": "65536",
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")
+    before = reg.snapshot(prefix="push.")
+    before_reads = reg.snapshot(prefix="reader.merged_reads")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=4,
+            partitioner=HashPartitioner(num_partitions),
+        )
+        driver.register_shuffle(handle)
+
+        def records_for(map_id):
+            return [
+                (f"key-{(map_id * 4000 + i) % 997}", map_id * 4000 + i)
+                for i in range(4000)
+            ]
+
+        expected = {}
+        for map_id, ex in [(0, ex0), (1, ex0), (2, ex1), (3, ex1)]:
+            for k, v in records_for(map_id):
+                expected.setdefault(k, []).append(v)
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(records_for(map_id)))
+            assert w.stop(True) is not None
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+
+        delta = reg.delta(before, prefix="push.")
+        assert _counter_total(delta, "pushed_blocks") > 0
+        assert _counter_total(delta, "merge_segments") == num_partitions
+
+        got = {}
+        for ex, (lo, hi) in [(ex0, (0, 3)), (ex1, (3, num_partitions))]:
+            reader = ex.get_reader(handle, lo, hi)
+            for k, v in reader.read():
+                got.setdefault(k, []).append(v)
+        assert set(got) == set(expected)
+        for k in expected:
+            assert sorted(got[k]) == sorted(expected[k])
+        # <= R + eps sequential reads: every partition was served by
+        # its ONE merged segment, none fell back
+        reads = _counter_total(
+            reg.delta(before_reads, prefix="reader.merged_reads"),
+            "merged_reads",
+        )
+        assert reads == num_partitions, (
+            f"expected {num_partitions} merged reads, saw {reads}"
+        )
+        assert _counter_total(
+            reg.delta(before, prefix="push."), "fallbacks"
+        ) == 0
+    finally:
+        ex0.stop()
+        ex1.stop()
+        driver.stop()
+
+
+def test_push_disabled_output_identical_and_legacy_frames():
+    """Feature-off run: zero push metrics move, no merged locations
+    exist, and the shuffle output is exactly the push-on run's output
+    (the byte-identity acceptance at the record level)."""
+    def run(push_on):
+        conf = TpuShuffleConf(
+            {
+                "tpu.shuffle.shuffleWriteMethod": "chunkedpartitionagg",
+                "tpu.shuffle.push.enabled": str(push_on).lower(),
+            }
+        )
+        driver = TpuShuffleManager(conf, is_driver=True)
+        ex = TpuShuffleManager(conf, is_driver=False, executor_id="solo-0")
+        try:
+            handle = BaseShuffleHandle(
+                shuffle_id=0, num_maps=2, partitioner=HashPartitioner(3)
+            )
+            driver.register_shuffle(handle)
+            for map_id in range(2):
+                w = ex.get_writer(handle, map_id)
+                w.write(iter((f"k{i % 53}", i) for i in range(2000)))
+                w.stop(True)
+            ex.finalize_maps(0)
+            locs = ex.fetch_remote_partition_locations(0, 0, 3).result(timeout=10)
+            merged_locs = [l for l in locs if l.block.merged_cover]
+            if push_on:
+                assert merged_locs
+            else:
+                assert not merged_locs
+            reader = ex.get_reader(handle, 0, 3)
+            return sorted(reader.read())
+        finally:
+            ex.stop()
+            driver.stop()
+
+    assert run(True) == run(False)

@@ -68,7 +68,12 @@ def _run_shuffle(method, num_records=4000, num_partitions=5):
             reader = ex.get_reader(handle, lo, hi)
             for k, v in reader.read():
                 got.setdefault(k, []).append(v)
-            assert reader.metrics.remote_blocks > 0  # remote READs happened
+            # data crossed executors: either as remote one-sided READs
+            # or as push-merged segments already landed on this side
+            # (push is best-effort, so which one wins is timing-dependent)
+            assert reader.metrics.remote_blocks > 0 or (
+                reader.metrics.merged_blocks > 0
+            )
             assert reader.metrics.local_blocks > 0
 
         assert set(got) == set(expected)
