@@ -1,0 +1,534 @@
+"""Multi-tenant concurrent-shuffle soak — the tenancy plane's ledger.
+
+One TpuContext serves closed-loop job streams from N tenants with
+unequal weights and unequal job sizes for ``--seconds`` wall-clock:
+hundreds of small mixed jobs (terasort-, hashjoin-, and
+pagerank-shaped RDD pipelines, every result verified) dispatched
+through the admission controller, the fair-share map/reduce pools, and
+the shuffle planes (DESIGN.md §19). The harness then interrogates the
+obs registry for the serving invariants:
+
+- **HWM flatness** — process-wide ``mempool.in_use_bytes`` /
+  ``hbm.in_use_bytes`` high-water marks must stop growing after the
+  first half (steady-state serving leaks nothing per job);
+- **no starvation** — every tenant completes jobs in the second half;
+- **p99 task latency** — per tenant, from the ``tenant.task_ms``
+  histogram bucket deltas between the halftime and final snapshots;
+- **fairness** (``--strict``) — each tenant's measured task-seconds
+  share within 25 %% (relative) of its weight share while all streams
+  stay backlogged;
+- **quota backpressure probe** — a dedicated segment installs a tiny
+  mempool quota for one tenant and proves it blocks (counters) while a
+  concurrent in-quota tenant's job latency stays near its solo
+  baseline (asserted under ``--strict``, recorded always);
+- **push-vs-rpc probe** — a short cluster-mode (subprocess workers)
+  segment under concurrent two-tenant load, verifying push volume
+  moves on the data plane and NEVER shows up as an ``rpc.handle_ms``
+  message type (recorded either way).
+
+Emits one JSON ledger (``--out``, default SOAK_r01.json) and exits
+nonzero when a required check fails. CI smoke:
+``python benchmarks/soak.py --seconds 20 --tenants 3`` — fails on HWM
+growth, a starved tenant, or any job failure; the fairness/quota bars
+are enforced by the acceptance run's ``--strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from sparkrdma_tpu.engine.context import TpuContext
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.tenancy import quota as _quota
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+WEIGHTS = [4, 2, 1, 1]          # unequal by construction
+JOB_ROWS = [3000, 2000, 1200, 1200]  # unequal job sizes, same order
+N_PARTS = 8                     # > task_threads: queues stay backlogged
+JOBS_IN_FLIGHT = 2              # per-tenant closed-loop concurrency
+
+# rpc.handle_ms message types that ARE control plane — anything else
+# appearing under concurrent push load is data volume leaking into the
+# metadata path
+CONTROL_RPC_TYPES = {
+    "MANAGER_HELLO",
+    "FETCH_PARTITION_LOCATIONS",
+    "PUBLISH_PARTITION_LOCATIONS",
+    "ANNOUNCE_MANAGERS",
+}
+
+
+# ---------------------------------------------------------------------------
+# job shapes — small, verified, all three planes of the mixed workload
+# ---------------------------------------------------------------------------
+def _terasort_job(ctx, rng, rows, tenant):
+    data = rng.integers(0, 1 << 30, rows).tolist()
+    rdd = (
+        ctx.parallelize(data, N_PARTS)
+        .map(lambda x: (int(x), None))
+        .sort_by_key(num_partitions=N_PARTS)
+    )
+    out = [k for k, _ in ctx.run_job(rdd, tenant=tenant)]
+    assert out == sorted(data), "terasort-shaped job produced unsorted output"
+
+
+def _hashjoin_job(ctx, rng, rows, tenant):
+    keys = rng.integers(0, rows, rows).tolist()
+    build = ctx.parallelize(
+        [(k, i) for i, k in enumerate(keys[: rows // 2])], N_PARTS // 2
+    )
+    probe = ctx.parallelize(
+        [(k, -i) for i, k in enumerate(keys)], N_PARTS // 2
+    )
+    rdd = build.join(probe, num_partitions=N_PARTS)
+    n = len(ctx.run_job(rdd, tenant=tenant))
+    assert n > 0, "hashjoin-shaped job joined nothing"
+
+
+def _pagerank_job(ctx, rng, rows, tenant):
+    n_vertices = max(50, rows // 20)
+    edges = rng.integers(0, n_vertices, (rows, 2))
+    deg = np.bincount(edges[:, 0], minlength=n_vertices)
+    rdd = (
+        ctx.parallelize(edges.tolist(), N_PARTS)
+        .map(lambda e: (int(e[1]), 1.0 / max(1, deg[e[0]])))
+        .reduce_by_key(lambda a, b: a + b, num_partitions=N_PARTS)
+    )
+    contribs = dict(ctx.run_job(rdd, tenant=tenant))
+    assert len(contribs) > 0 and all(v > 0 for v in contribs.values())
+
+
+SHAPES = [_terasort_job, _hashjoin_job, _pagerank_job]
+
+
+# ---------------------------------------------------------------------------
+# registry helpers
+# ---------------------------------------------------------------------------
+def _p99_from_bucket_delta(half: dict, end: dict) -> float | None:
+    """p99 (ms) of the observations BETWEEN two full histogram
+    snapshots, by linear interpolation over the bucket-count deltas."""
+    items = []
+    overflow = 0
+    for key, c_end in end.get("buckets", {}).items():
+        d = c_end - half.get("buckets", {}).get(key, 0)
+        if key == "overflow":
+            overflow = d
+        else:
+            items.append((float(key[3:]), d))
+    items.sort()
+    total = sum(d for _, d in items) + overflow
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    cum = 0
+    lo = 0.0
+    for bound, d in items:
+        if cum + d >= target:
+            frac = (target - cum) / d if d else 1.0
+            return round(lo + frac * (bound - lo), 3)
+        cum += d
+        lo = bound
+    return round(end.get("max") or lo, 3)  # landed in overflow
+
+
+def _tenant_task_stats(snap_half, snap_end, tenant):
+    """(task_seconds, p99_ms) for one tenant across its pools, from the
+    halftime-vs-end delta of every tenant.task_ms histogram."""
+    secs = 0.0
+    merged_half = {"buckets": {}}
+    merged_end = {"buckets": {}, "max": 0.0}
+    for key, h_end in snap_end["histograms"].items():
+        if not key.startswith("tenant.task_ms") or f"tenant={tenant}" not in key:
+            continue
+        h_half = snap_half["histograms"].get(
+            key, {"count": 0, "sum": 0.0, "buckets": {}}
+        )
+        secs += (h_end["sum"] - h_half.get("sum", 0.0)) / 1e3
+        for b, c in h_end.get("buckets", {}).items():
+            merged_end["buckets"][b] = merged_end["buckets"].get(b, 0) + c
+        for b, c in h_half.get("buckets", {}).items():
+            merged_half["buckets"][b] = merged_half["buckets"].get(b, 0) + c
+        merged_end["max"] = max(merged_end["max"], h_end.get("max") or 0.0)
+    return secs, _p99_from_bucket_delta(merged_half, merged_end)
+
+
+def _hwm(snap, name) -> int:
+    g = snap["gauges"].get(name)
+    return int(g["hwm"]) if g else 0
+
+
+# ---------------------------------------------------------------------------
+# soak phases
+# ---------------------------------------------------------------------------
+def run_soak(args) -> dict:
+    tenants = [f"tenant-{i}" for i in range(args.tenants)]
+    weights = {t: WEIGHTS[i] for i, t in enumerate(tenants)}
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.tenancy.weights": ",".join(
+                f"{t}:{w}" for t, w in weights.items()
+            ),
+            # mapped (zero-copy page-cache) delivery bypasses the pooled
+            # destination buffers entirely, which would make the mempool
+            # HWM-flatness check vacuous — soak the pooled plane instead
+            "tpu.shuffle.mappedFetch": "false",
+        }
+    )
+    reg = get_registry()
+    stats = {
+        t: {"jobs": 0, "jobs_2nd_half": 0, "failures": [], "by_shape": {}}
+        for t in tenants
+    }
+    lock = threading.Lock()
+    halftime = {"snap": None, "at": 0.0}
+    deadline = time.monotonic() + args.seconds
+    half_at = time.monotonic() + args.seconds / 2.0
+
+    with TpuContext(num_executors=2, conf=conf, task_threads=4) as ctx:
+        def stream(tenant, idx, slot):
+            rng = np.random.default_rng(args.seed * 1000 + idx * 10 + slot)
+            rows = int(JOB_ROWS[idx] * args.scale)
+            k = slot
+            while time.monotonic() < deadline:
+                shape = SHAPES[k % len(SHAPES)]
+                k += 1
+                try:
+                    shape(ctx, rng, rows, tenant)
+                except Exception as e:  # noqa: BLE001 — ledgered
+                    with lock:
+                        stats[tenant]["failures"].append(
+                            f"{shape.__name__}: {type(e).__name__}: {e}"
+                        )
+                    continue
+                with lock:
+                    stats[tenant]["jobs"] += 1
+                    name = shape.__name__.strip("_")
+                    stats[tenant]["by_shape"][name] = (
+                        stats[tenant]["by_shape"].get(name, 0) + 1
+                    )
+                    if halftime["snap"] is not None:
+                        stats[tenant]["jobs_2nd_half"] += 1
+
+        threads = [
+            threading.Thread(
+                target=stream, args=(t, i, s), name=f"soak-{t}-{s}"
+            )
+            for i, t in enumerate(tenants)
+            for s in range(JOBS_IN_FLIGHT)
+        ]
+        for t in threads:
+            t.start()
+        # halftime snapshot: the steady-state baseline every flatness
+        # and latency delta is measured against
+        while time.monotonic() < half_at:
+            time.sleep(0.1)
+        halftime["snap"] = reg.snapshot()
+        halftime["at"] = time.monotonic()
+        for t in threads:
+            t.join(timeout=args.seconds + 120)
+        snap_end = reg.snapshot()
+
+    # ---- per-tenant ledger -------------------------------------------
+    total_secs = 0.0
+    per_tenant = {}
+    for i, t in enumerate(tenants):
+        secs, p99 = _tenant_task_stats(halftime["snap"], snap_end, t)
+        total_secs += secs
+        per_tenant[t] = {
+            "weight": weights[t],
+            "jobs": stats[t]["jobs"],
+            "jobs_2nd_half": stats[t]["jobs_2nd_half"],
+            "by_shape": stats[t]["by_shape"],
+            "failures": stats[t]["failures"][:5],
+            "task_seconds_2nd_half": round(secs, 3),
+            "p99_task_ms_2nd_half": p99,
+        }
+    weight_total = sum(weights.values())
+    max_rel_dev = 0.0
+    for t in tenants:
+        share = per_tenant[t]["task_seconds_2nd_half"] / total_secs if total_secs else 0.0
+        wshare = weights[t] / weight_total
+        rel = abs(share - wshare) / wshare
+        per_tenant[t]["task_seconds_share"] = round(share, 4)
+        per_tenant[t]["weight_share"] = round(wshare, 4)
+        per_tenant[t]["share_rel_dev"] = round(rel, 4)
+        max_rel_dev = max(max_rel_dev, rel)
+
+    # ---- HWM flatness ------------------------------------------------
+    hwms = {}
+    for name in ("mempool.in_use_bytes", "hbm.in_use_bytes"):
+        h0, h1 = _hwm(halftime["snap"], name), _hwm(snap_end, name)
+        growth = (h1 - h0) / h0 if h0 else 0.0
+        hwms[name] = {
+            "halftime_hwm": h0,
+            "final_hwm": h1,
+            "growth_pct": round(growth * 100, 2),
+        }
+
+    return {
+        "per_tenant": per_tenant,
+        "fairness_max_rel_dev": round(max_rel_dev, 4),
+        "hwm": hwms,
+        "admission": {
+            k: v
+            for k, v in snap_end["counters"].items()
+            if k.startswith("admission.")
+        },
+    }
+
+
+def run_quota_probe(args) -> dict:
+    """Quota backpressure proof: 'probe-hog' gets a tiny mempool quota
+    and must block (counters) yet keep progressing (bounded overruns),
+    while the unquota'd 'probe-quiet' tenant's job latency stays near
+    its solo baseline."""
+    reg = get_registry()
+
+    def quiet_jobs(ctx, n, tenant="probe-quiet"):
+        rng = np.random.default_rng(args.seed + 99)
+        walls = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            _pagerank_job(ctx, rng, int(1500 * args.scale), tenant)
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls))
+
+    # solo baseline: no quotas installed, quiet tenant alone. Both
+    # contexts run with mapped delivery off so fetches land in pooled
+    # registered buffers — the plane the mempool quota governs.
+    base = {"tpu.shuffle.mappedFetch": "false"}
+    with TpuContext(
+        num_executors=2, conf=TpuShuffleConf(dict(base)), task_threads=4
+    ) as ctx:
+        quiet_jobs(ctx, 2)  # warm
+        solo = quiet_jobs(ctx, 5)
+
+    # contended run: hog capped at ~one pooled destination buffer with a
+    # short overrun deadline — every concurrent in-flight fetch group
+    # beyond the first must block, yet the hog keeps crawling forward
+    _quota.reset()
+    conf = TpuShuffleConf(
+        dict(
+            base,
+            **{
+                "tpu.shuffle.tenancy.quota.probe-hog.mempoolBytes": "8k",
+                "tpu.shuffle.tenancy.quotaBlockMaxMs": "200",
+            },
+        )
+    )
+    before = reg.snapshot(prefix="tenant.quota")
+    stop = threading.Event()
+    hog_jobs = {"n": 0}
+
+    def hog():
+        rng = np.random.default_rng(args.seed + 7)
+        while not stop.is_set():
+            try:
+                _terasort_job(ctx, rng, int(2000 * args.scale), "probe-hog")
+                hog_jobs["n"] += 1
+            except Exception:  # noqa: BLE001 — the probe only needs load
+                pass
+
+    try:
+        with TpuContext(num_executors=2, conf=conf, task_threads=4) as ctx:
+            hog_t = threading.Thread(target=hog, name="soak-quota-hog")
+            hog_t.start()
+            time.sleep(0.5)  # let the hog hit its quota first
+            contended = quiet_jobs(ctx, 5)
+            stop.set()
+            hog_t.join(timeout=120)
+    finally:
+        _quota.reset()
+    delta = reg.delta(before, prefix="tenant.quota")["counters"]
+    blocks = sum(
+        v for k, v in delta.items()
+        if k.startswith("tenant.quota_blocks") and "probe-hog" in k
+    )
+    overruns = sum(
+        v for k, v in delta.items()
+        if k.startswith("tenant.quota_overruns") and "probe-hog" in k
+    )
+    return {
+        "quiet_solo_median_s": round(solo, 4),
+        "quiet_contended_median_s": round(contended, 4),
+        "quiet_slowdown": round(contended / solo, 3) if solo else None,
+        "hog_quota_blocks": blocks,
+        "hog_quota_overruns": overruns,
+        "hog_jobs_completed": hog_jobs["n"],
+    }
+
+
+def run_push_rpc_probe(args) -> dict:
+    """Cluster-mode (subprocess workers) two-tenant concurrent load
+    with the push/merge plane on: push volume must move on the data
+    plane (task protocol) and never surface as an rpc.handle_ms
+    message type on the metadata plane."""
+    from sparkrdma_tpu.engine.cluster import ClusterContext
+
+    reg = get_registry()
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "chunkedpartitionagg",
+            "tpu.shuffle.shuffleWriteBlockSize": "65536",
+            "tpu.shuffle.shuffleReadBlockSize": "65536",
+            "tpu.shuffle.push.enabled": "true",
+            "tpu.shuffle.obs.telemetry.intervalMs": "200",
+        }
+    )
+    before = reg.snapshot(prefix="rpc.")
+    rows = int(4000 * args.scale)
+    with ClusterContext(num_executors=2, conf=conf) as cluster:
+        def one_job(tenant, mod):
+            map_fns = [
+                (lambda lo=p * rows: iter(
+                    (f"k-{(lo + i) % mod}", 1) for i in range(rows)
+                ))
+                for p in range(4)
+            ]
+            out = cluster.run_map_reduce(
+                map_fns, num_partitions=4,
+                reduce_fn=lambda it: [sum(1 for _ in it)],
+                tenant=tenant,
+            )
+            total = sum(c for per_worker in out for c in per_worker)
+            assert total == 4 * rows, f"{tenant}: {total} != {4 * rows}"
+
+        threads = [
+            threading.Thread(target=one_job, args=(f"push-t{j}", 211 + j))
+            for j in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        # the final push counters ride the NEXT worker heartbeat and the
+        # NEXT driver poll after job end — poll the timeline (bounded)
+        # instead of racing a fixed sleep against two timers
+        pushed = 0
+        poll_deadline = time.monotonic() + 10.0
+        while time.monotonic() < poll_deadline:
+            pushed = 0
+            for windows in cluster.driver.telemetry.timeline().values():
+                for w in windows:
+                    for k, v in (w.get("counters") or {}).items():
+                        if k.startswith("push.pushed_bytes"):
+                            pushed += v
+            if pushed > 0:
+                break
+            time.sleep(0.3)
+    delta = reg.delta(before, prefix="rpc.")
+    rpc_types = set()
+    for key in delta["histograms"]:
+        if key.startswith("rpc.handle_ms"):
+            for part in key[len("rpc.handle_ms{"):-1].split(","):
+                k, _, v = part.partition("=")
+                if k == "type":
+                    rpc_types.add(v)
+    return {
+        "pushed_bytes": pushed,
+        "rpc_handle_types_seen": sorted(rpc_types),
+        "push_in_rpc_handle_ms": bool(rpc_types - CONTROL_RPC_TYPES),
+    }
+
+
+# ---------------------------------------------------------------------------
+def main() -> int:
+    ap = argparse.ArgumentParser(description="multi-tenant shuffle soak")
+    ap.add_argument("--seconds", type=float, default=60.0)
+    ap.add_argument("--tenants", type=int, default=4, choices=[3, 4])
+    ap.add_argument("--out", default="SOAK_r01.json")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="additionally enforce the fairness (25%%) and quota-"
+        "neighborhood (10%%) bars — the acceptance-run mode; without "
+        "it they are recorded but only HWM flatness, zero job "
+        "failures, and no starvation gate the exit code",
+    )
+    ap.add_argument(
+        "--skip-cluster-probe",
+        action="store_true",
+        help="skip the subprocess push-vs-rpc segment",
+    )
+    args = ap.parse_args()
+
+    ledger = {
+        "args": {
+            "seconds": args.seconds,
+            "tenants": args.tenants,
+            "scale": args.scale,
+            "seed": args.seed,
+            "strict": args.strict,
+        },
+    }
+    ledger["soak"] = run_soak(args)
+    ledger["quota_probe"] = run_quota_probe(args)
+    if not args.skip_cluster_probe:
+        try:
+            ledger["push_rpc_probe"] = run_push_rpc_probe(args)
+        except Exception as e:  # noqa: BLE001 — recorded, CI-gated below
+            ledger["push_rpc_probe"] = {
+                "error": f"{type(e).__name__}: {e}"
+            }
+
+    # ---- verdicts ----------------------------------------------------
+    checks = {}
+    soak = ledger["soak"]
+    checks["zero_job_failures"] = all(
+        not v["failures"] for v in soak["per_tenant"].values()
+    )
+    checks["no_starved_tenant"] = all(
+        v["jobs_2nd_half"] >= 1 for v in soak["per_tenant"].values()
+    )
+    checks["hwm_flat"] = all(
+        h["growth_pct"] <= 10.0 for h in soak["hwm"].values()
+    )
+    checks["quota_backpressure_engaged"] = (
+        ledger["quota_probe"]["hog_quota_blocks"] >= 1
+        and ledger["quota_probe"]["hog_jobs_completed"] >= 1
+    )
+    probe = ledger.get("push_rpc_probe", {})
+    if "error" not in probe and probe:
+        checks["push_absent_from_rpc_handle_ms"] = (
+            not probe["push_in_rpc_handle_ms"] and probe["pushed_bytes"] > 0
+        )
+    if args.strict:
+        checks["fairness_within_25pct"] = soak["fairness_max_rel_dev"] <= 0.25
+        slowdown = ledger["quota_probe"]["quiet_slowdown"]
+        cores = os.cpu_count() or 1
+        if cores >= 4:
+            checks["quiet_within_10pct_of_solo"] = (
+                slowdown is not None and slowdown <= 1.10
+            )
+        else:
+            # on a rig with fewer cores than the two concurrent
+            # workloads need, the quiet tenant pays raw CPU contention
+            # that no memory-quota backpressure can remove — record the
+            # ratio, enforce the bar only where it is measurable
+            ledger["quota_probe"]["quiet_isolation_note"] = (
+                f"10% neighbor-isolation bar not enforced: {cores} core(s)"
+                " < 4, quiet tenant's slowdown is CPU contention, not"
+                " quota spillover"
+            )
+    ledger["checks"] = checks
+    ledger["ok"] = all(checks.values())
+
+    with open(args.out, "w") as f:
+        json.dump(ledger, f, indent=2, sort_keys=True)
+    print(json.dumps({"ok": ledger["ok"], "checks": checks, "out": args.out}))
+    return 0 if ledger["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

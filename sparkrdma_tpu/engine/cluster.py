@@ -25,6 +25,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
+from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.engine.worker import _recv_obj, _send_obj
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner, Partitioner
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
@@ -138,26 +139,41 @@ class ClusterContext:
         num_partitions: int,
         reduce_fn: Optional[Callable] = None,
         partitioner: Optional[Partitioner] = None,
+        tenant: Optional[str] = None,
     ) -> List:
         """One full distributed job: every ``map_fns[i]`` runs on a
         worker process and yields (k, v) records; records repartition by
         key across all workers; ``reduce_fn(iterator)`` runs per
         partition range on its worker. Returns the per-worker reduce
-        results in worker order."""
+        results in worker order.
+
+        ``tenant`` rides every task request so the workers' fair-share
+        pools, quotas, and breaker keys attribute the job correctly;
+        the driver's admission controller brackets the whole job."""
+        t = tenant or tenancy.current_tenant()
         handle = BaseShuffleHandle(
             shuffle_id=self._next_shuffle_id(),
             num_maps=len(map_fns),
             partitioner=partitioner or HashPartitioner(num_partitions),
         )
         self.driver.register_shuffle(handle)
+        admission = self.driver.admission
         try:
-            return self._run_map_reduce(handle, map_fns, num_partitions, reduce_fn)
+            with tenancy.tenant_scope(t):
+                if admission is None:
+                    return self._run_map_reduce(
+                        handle, map_fns, num_partitions, reduce_fn, t
+                    )
+                with admission.admit(t):
+                    return self._run_map_reduce(
+                        handle, map_fns, num_partitions, reduce_fn, t
+                    )
         except Exception as e:
             if self.driver.telemetry is not None:
                 self.driver.telemetry.flight_record("job_failed", error=e)
             raise
 
-    def _run_map_reduce(self, handle, map_fns, num_partitions, reduce_fn):
+    def _run_map_reduce(self, handle, map_fns, num_partitions, reduce_fn, tenant):
         # group this stage's tasks by worker and ship each group as ONE
         # map_batch request: one socket round trip per worker instead of
         # one per map, with the worker's bounded map pool (conf
@@ -178,6 +194,7 @@ class ClusterContext:
                     "handle": handle,
                     "tasks": tasks,
                     "push_routes": push_routes,
+                    "tenant": tenant,
                 },
             )
             for w, tasks in by_worker.items()
@@ -216,6 +233,7 @@ class ClusterContext:
                     "start": lo,
                     "end": hi,
                     "reduce_fn": reduce_fn,
+                    "tenant": tenant,
                 },
             )
             for w, (lo, hi) in enumerate(bounds)

@@ -28,6 +28,8 @@ from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.errors import ShuffleError
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.tenancy import FairShareExecutor
 from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.utils.config import TpuShuffleConf
 
@@ -47,7 +49,20 @@ class TpuContext:
             TpuShuffleManager(self.conf, is_driver=False, executor_id=f"exec-{i}")
             for i in range(num_executors)
         ]
-        self._pool = ThreadPoolExecutor(max_workers=task_threads)
+        # reduce-task pool: deficit-round-robin across tenants when
+        # tenancy is on (one tenant's 1000 queued partitions cannot
+        # convoy another's 10), plain FIFO otherwise
+        if self.conf.tenancy_enabled:
+            self._pool = FairShareExecutor(
+                max_workers=task_threads,
+                weights=self.conf.tenancy_weights,
+                default_weight=self.conf.tenancy_default_weight,
+                quantum_ms=self.conf.tenancy_quantum_ms,
+                thread_name_prefix="reduce",
+                pool="reduce",
+            )
+        else:
+            self._pool = ThreadPoolExecutor(max_workers=task_threads)
         self._id_lock = threading.Lock()
         self._rdd_counter = 0
         self._shuffle_counter = 0
@@ -158,7 +173,8 @@ class TpuContext:
                         raise
                 finally:
                     get_registry().histogram(
-                        "engine.task_ms", role=executor.executor_id, kind="map"
+                        "engine.task_ms", role=executor.executor_id,
+                        kind="map", tenant=tenancy.current_tenant(),
                     ).observe((time.perf_counter() - t0) * 1000.0)
 
             # dispatch each map through ITS executor's bounded map pool
@@ -207,8 +223,23 @@ class TpuContext:
                     return sizes
         return {}
 
-    def run_job(self, rdd: RDD) -> List:
-        """Compute all partitions of rdd; recompute stages on fetch failure."""
+    def run_job(self, rdd: RDD, tenant: Optional[str] = None) -> List:
+        """Compute all partitions of rdd; recompute stages on fetch failure.
+
+        ``tenant`` names the job's owner for admission, fair-share
+        dispatch, quotas, breaker scoping, and obs labels (defaults to
+        the calling thread's tenant scope). Admission brackets the
+        WHOLE job including recompute attempts — the in-flight bound
+        counts jobs, not stages."""
+        t = tenant or tenancy.current_tenant()
+        admission = self.driver.admission
+        with tenancy.tenant_scope(t):
+            if admission is None:
+                return self._run_job_admitted(rdd, t)
+            with admission.admit(t):
+                return self._run_job_admitted(rdd, t)
+
+    def _run_job_admitted(self, rdd: RDD, tenant: str) -> List:
         for attempt in range(2):
             try:
                 self.ensure_parents(rdd)
@@ -216,8 +247,19 @@ class TpuContext:
                 weights = self._partition_weights(rdd)
                 if weights:
                     order.sort(key=lambda p: -weights.get(p, 0))
+
+                def run_reduce(p: int) -> List:
+                    t0 = time.perf_counter()
+                    try:
+                        return list(rdd.compute(p))
+                    finally:
+                        get_registry().histogram(
+                            "engine.task_ms", role="driver", kind="reduce",
+                            tenant=tenancy.current_tenant(),
+                        ).observe((time.perf_counter() - t0) * 1000.0)
+
                 futures = {
-                    p: self._pool.submit(lambda p=p: list(rdd.compute(p)))
+                    p: self._pool.submit(run_reduce, p)
                     for p in order
                 }
                 out: List = []

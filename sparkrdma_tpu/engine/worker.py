@@ -34,6 +34,7 @@ import traceback
 
 import cloudpickle
 
+from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.obs.metrics import get_registry
 from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
@@ -96,7 +97,8 @@ class Worker:
                 raise
         finally:
             get_registry().histogram(
-                "engine.task_ms", role=self.manager.executor_id, kind="map"
+                "engine.task_ms", role=self.manager.executor_id, kind="map",
+                tenant=tenancy.current_tenant(),
             ).observe((time.perf_counter() - t0) * 1000.0)
 
     def handle(self, req):
@@ -106,9 +108,10 @@ class Worker:
         if kind == "map":
             # single map: still bounded by the pool so concurrent task
             # connections can't oversubscribe the process
-            self.manager.map_pool.submit(
-                self._run_map, req["handle"], req["map_id"], req["records_fn"]
-            ).result()
+            with tenancy.tenant_scope(req.get("tenant")):
+                self.manager.map_pool.submit(
+                    self._run_map, req["handle"], req["map_id"], req["records_fn"]
+                ).result()
             return {"ok": True}
         if kind == "map_batch":
             # one request, N map tasks, bounded concurrency: every task
@@ -120,10 +123,15 @@ class Worker:
                 # {executor_id: (host, task_port)}: where this worker's
                 # push client ships sealed blocks (shuffle/merge.py)
                 self.manager.push_client.set_routes(routes)
-            futures = [
-                self.manager.map_pool.submit(self._run_map, req["handle"], mid, fn)
-                for mid, fn in req["tasks"]
-            ]
+            # the submit captures the tenant scope, so the fair-share
+            # pool queues this batch under the requesting tenant
+            with tenancy.tenant_scope(req.get("tenant")):
+                futures = [
+                    self.manager.map_pool.submit(
+                        self._run_map, req["handle"], mid, fn
+                    )
+                    for mid, fn in req["tasks"]
+                ]
             errors = [f.exception() for f in futures]
             errors = [e for e in errors if e is not None]
             if errors:
@@ -152,19 +160,20 @@ class Worker:
             plan = _faults.active()
             if plan is not None:
                 plan.on_stage("reduce_task", [], peer=self.manager.executor_id)
-            reader = self.manager.get_reader(handle, req["start"], req["end"])
-            try:
-                it = reader.read()
-                fn = req.get("reduce_fn")
-                result = fn(it) if fn is not None else list(it)
-            finally:
-                # task-completion sweep: a reduce_fn that bails without
-                # consuming must not strand fetched streams until GC
-                reader.close()
-                get_registry().histogram(
-                    "engine.task_ms", role=self.manager.executor_id,
-                    kind="reduce",
-                ).observe((time.perf_counter() - t0) * 1000.0)
+            with tenancy.tenant_scope(req.get("tenant")):
+                reader = self.manager.get_reader(handle, req["start"], req["end"])
+                try:
+                    it = reader.read()
+                    fn = req.get("reduce_fn")
+                    result = fn(it) if fn is not None else list(it)
+                finally:
+                    # task-completion sweep: a reduce_fn that bails without
+                    # consuming must not strand fetched streams until GC
+                    reader.close()
+                    get_registry().histogram(
+                        "engine.task_ms", role=self.manager.executor_id,
+                        kind="reduce", tenant=tenancy.current_tenant(),
+                    ).observe((time.perf_counter() - t0) * 1000.0)
             return {"ok": True, "result": result}
         if kind == "telemetry":
             # control-plane pull: hand buffered heartbeats to the driver

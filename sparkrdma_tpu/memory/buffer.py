@@ -207,6 +207,14 @@ class TpuBuffer:
         if self._freed:
             return
         self._freed = True
+        if getattr(self, "_mempool_charge", None) is not None:
+            # pool-tagged buffer retired without passing through
+            # TpuBufferManager.put — release its accounting here so the
+            # tenant quota and in-use gauge never leak (tag is only
+            # ever set by the manager, so the module is loaded)
+            from sparkrdma_tpu.memory.buffer_manager import release_charge
+
+            release_charge(self)
         if self._pd is not None and self.mkey:
             self._pd.deregister(self.mkey)
         view, self._view = self._view, None

@@ -25,6 +25,8 @@ from typing import Deque, Dict, Optional
 from sparkrdma_tpu.memory.buffer import TpuBuffer
 from sparkrdma_tpu.memory.registry import ProtectionDomain
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.tenancy import current_tenant
+from sparkrdma_tpu.tenancy import quota as _quota
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +38,19 @@ _M_POOL_HITS = get_registry().counter("mempool.hits")
 _M_POOL_MISSES = get_registry().counter("mempool.misses")
 _M_POOL_RETURNS = get_registry().counter("mempool.returns")
 _M_POOL_FREES = get_registry().counter("mempool.frees")
+_G_IN_USE = get_registry().gauge("mempool.in_use_bytes")
+
+
+def release_charge(buf: TpuBuffer) -> None:
+    """Retire a buffer's outstanding accounting tag (idempotent)."""
+    tag = getattr(buf, "_mempool_charge", None)
+    if tag is None:
+        return
+    buf._mempool_charge = None
+    broker, tenant, cls = tag
+    _G_IN_USE.add(-cls)
+    if broker is not None:
+        broker.release(tenant, cls)
 
 
 def next_power_of_2(n: int) -> int:
@@ -111,10 +126,29 @@ class TpuBufferManager:
             return stack
 
     def get(self, length: int) -> TpuBuffer:
-        """Get a registered buffer of capacity ≥ length (pooled)."""
+        """Get a registered buffer of capacity ≥ length (pooled).
+
+        The tenant quota charge gates the allocation: an over-quota
+        tenant's worker blocks HERE (backpressure on its own stage/push
+        thread) until its earlier buffers are released. The charge tag
+        rides the buffer so release (put or free, whichever retires it
+        first) is idempotent."""
         if self._stopped:
             raise RuntimeError("buffer manager stopped")
-        return self._stack_for(next_power_of_2(length)).get()
+        cls = next_power_of_2(length)
+        broker = _quota.broker("mempool")
+        tenant = current_tenant() if broker is not None else None
+        if broker is not None:
+            broker.charge(tenant, cls)
+        try:
+            buf = self._stack_for(cls).get()
+        except BaseException:
+            if broker is not None:
+                broker.release(tenant, cls)
+            raise
+        buf._mempool_charge = (broker, tenant, cls)
+        _G_IN_USE.add(cls)
+        return buf
 
     def put(self, buf: TpuBuffer) -> None:
         """Return a buffer to the pool (or free, if foreign or unregistered).
@@ -123,6 +157,7 @@ class TpuBufferManager:
         registered pool — a consumer would publish mkey 0 and remote
         READs would fail at the peer's PD.
         """
+        release_charge(buf)
         with self._lock:
             stack = self._stacks.get(buf.length) if buf.mkey else None
         if stack is None or self._stopped or not stack.put(buf):

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.tenancy import current_tenant
+from sparkrdma_tpu.tenancy import quota as _quota
 
 logger = logging.getLogger(__name__)
 
@@ -71,7 +73,7 @@ class DeviceBuffer:
 
     __slots__ = (
         "handle", "capacity", "length", "array", "_manager", "_host",
-        "_disk", "_tier_lock", "last_use",
+        "_disk", "_tier_lock", "last_use", "tenant", "_quota_tag",
     )
 
     def __init__(self, handle: int, capacity: int, array, manager):
@@ -80,6 +82,8 @@ class DeviceBuffer:
         self.length = 0
         self.array = array
         self._manager = manager
+        self.tenant = None  # owning tenant id (spill-victim preference)
+        self._quota_tag = None  # (broker, tenant, cls) while charged
         self._host: Optional[np.ndarray] = None  # set while in host tier
         self._disk = None  # (path, dtype_str, count) while in disk tier
         # serializes TIER MOVES of this buffer (manager-initiated
@@ -445,6 +449,17 @@ class DeviceBufferManager:
             ]
             if not candidates:
                 return None
+            broker = _quota.broker("hbm")
+            if broker is not None:
+                # an over-quota tenant's slabs go first: its own hoard
+                # pays for the pressure it created, LRU breaks ties
+                return min(
+                    candidates,
+                    key=lambda b: (
+                        not (b.tenant and broker.over_quota(b.tenant)),
+                        b.last_use,
+                    ),
+                )
             return min(candidates, key=lambda b: b.last_use)
 
     def _make_room(self, cls: int, pinned=frozenset()) -> None:
@@ -600,7 +615,27 @@ class DeviceBufferManager:
         """Allocate (or reuse) a slab whose class covers ``nbytes``.
 
         Under budget pressure, least-recently-used live slabs spill to
-        host RAM first; MemoryError only when nothing is spillable."""
+        host RAM first; MemoryError only when nothing is spillable.
+        When an hbm quota broker is installed, the tenant's charge
+        gates the allocation — an over-quota tenant blocks here, on
+        its own worker thread, until its earlier slabs are put back
+        (capacity is charged for the get→put lifetime, so spilling a
+        slab to host does NOT un-block its tenant)."""
+        broker = _quota.broker("hbm")
+        if broker is None:
+            return self._get_slab(nbytes, None)
+        tenant = current_tenant()
+        cls = _size_class(nbytes)
+        broker.charge(tenant, cls)
+        try:
+            buf = self._get_slab(nbytes, tenant)
+        except BaseException:
+            broker.release(tenant, cls)
+            raise
+        buf._quota_tag = (broker, tenant, cls)
+        return buf
+
+    def _get_slab(self, nbytes: int, tenant) -> DeviceBuffer:
         cls = _size_class(nbytes)
         with self._lock:
             if self._stopped:
@@ -610,6 +645,8 @@ class DeviceBufferManager:
             pooled = stack.stack.pop() if stack.stack else None
             if pooled is not None:
                 pooled.length = nbytes
+                pooled.tenant = tenant
+                pooled._quota_tag = None
                 self._in_use_bytes += cls
                 self._handles[pooled.handle] = pooled
                 self._use_clock += 1
@@ -637,6 +674,7 @@ class DeviceBufferManager:
             arr = jax.device_put(jnp.zeros((cls,), dtype=jnp.uint8), self.device)
             buf = DeviceBuffer(handle, cls, arr, self)
             buf.length = nbytes
+            buf.tenant = tenant
             with self._lock:
                 self._handles[handle] = buf
                 self._use_clock += 1
@@ -672,6 +710,11 @@ class DeviceBufferManager:
                     disk, buf._disk = buf._disk, None
                 else:
                     disk = None
+            tag, buf._quota_tag = buf._quota_tag, None
+            if tag is not None:
+                # held-capacity quota retires with the slab, whatever
+                # tier the bytes ended up in
+                tag[0].release(tag[1], tag[2])
             if disk is not None:
                 try:
                     os.unlink(disk[0])

@@ -22,8 +22,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
+from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.obs import get_registry
 
 logger = logging.getLogger(__name__)
@@ -120,6 +121,12 @@ class SourceHealthRegistry:
     The breaker keys on executor identity (not host:port) to match
     ShuffleManagerId equality semantics: a respawned executor under the
     same id inherits — and must re-earn — its predecessor's health.
+
+    Tenancy: breakers are additionally scoped per tenant
+    (``"<tenant>:<executor_id>"``) so one tenant's fault plan tripping
+    a peer's circuit cannot fail-fast ANOTHER tenant's fetches from
+    the same peer. The default tenant keeps the bare executor_id key —
+    single-tenant deployments see exactly the pre-tenancy keyspace.
     """
 
     def __init__(self, conf, role: str = ""):
@@ -136,27 +143,42 @@ class SourceHealthRegistry:
             "resilience.straggler_advisories", role=role
         )
 
-    def get(self, executor_id: str) -> CircuitBreaker:
+    @staticmethod
+    def _key(executor_id: str, tenant: Optional[str]) -> str:
+        t = tenant if tenant is not None else tenancy.current_tenant()
+        if t == tenancy.DEFAULT_TENANT:
+            return executor_id
+        return f"{t}:{executor_id}"
+
+    def get(
+        self, executor_id: str, tenant: Optional[str] = None
+    ) -> CircuitBreaker:
+        key = self._key(executor_id, tenant)
         with self._lock:
-            br = self._breakers.get(executor_id)
+            br = self._breakers.get(key)
             if br is None:
                 br = CircuitBreaker(self._threshold, self._open_ms)
-                self._breakers[executor_id] = br
+                self._breakers[key] = br
             return br
 
-    def allow(self, executor_id: str) -> bool:
-        return self.get(executor_id).allow()
+    def allow(self, executor_id: str, tenant: Optional[str] = None) -> bool:
+        return self.get(executor_id, tenant).allow()
 
-    def record_success(self, executor_id: str) -> None:
-        if self.get(executor_id).record_success():
+    def record_success(
+        self, executor_id: str, tenant: Optional[str] = None
+    ) -> None:
+        if self.get(executor_id, tenant).record_success():
             self._m_close.inc()
             logger.info("circuit to %s closed (probe succeeded)", executor_id)
 
-    def record_failure(self, executor_id: str) -> None:
-        if self.get(executor_id).record_failure():
+    def record_failure(
+        self, executor_id: str, tenant: Optional[str] = None
+    ) -> None:
+        if self.get(executor_id, tenant).record_failure():
             self._m_open.inc()
             logger.warning(
-                "circuit to %s opened after consecutive failures", executor_id
+                "circuit to %s opened after consecutive failures",
+                self._key(executor_id, tenant),
             )
 
     def states(self) -> Dict[str, str]:

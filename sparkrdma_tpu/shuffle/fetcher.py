@@ -41,6 +41,7 @@ import time
 from dataclasses import dataclass, field
 from typing import BinaryIO, Dict, List, Optional, Tuple
 
+from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
@@ -143,6 +144,9 @@ class TpuShuffleFetcherIterator:
         # with the manager), and the resilience.* counter family
         self._retry_policy = RetryPolicy.from_conf(manager.conf)
         self._health = manager.health
+        # captured once: breaker calls and retries land on completion
+        # and timer threads that carry no tenant scope of their own
+        self._tenant = tenancy.current_tenant()
         self._m_retries = reg.counter("resilience.retries", role=role)
         self._m_checksum_failures = reg.counter(
             "resilience.checksum_failures", role=role
@@ -169,8 +173,13 @@ class TpuShuffleFetcherIterator:
 
     # ------------------------------------------------------------------
     def _start(self) -> None:
+        # the resolver thread allocates destination buffers and posts
+        # the initial READs: run it under the owning tenant's scope so
+        # quota charges and fault/breaker attribution stay correct
         threading.Thread(
-            target=self._resolve_and_fetch, name="fetcher-locations", daemon=True
+            target=tenancy.scoped(self._tenant, self._resolve_and_fetch),
+            name="fetcher-locations",
+            daemon=True,
         ).start()
 
     def _resolve_and_fetch(self) -> None:
@@ -361,7 +370,7 @@ class TpuShuffleFetcherIterator:
         failed_attempt = fetch.attempt
         retryable = not isinstance(error, CircuitOpenError)
         if retryable:
-            self._health.record_failure(mid.executor_id)
+            self._health.record_failure(mid.executor_id, tenant=self._tenant)
         with self._lock:
             closed = self._closed
         if (
@@ -393,16 +402,21 @@ class TpuShuffleFetcherIterator:
 
     def _retry_fetch(self, fetch: _PendingFetch) -> None:
         """Issue the next rung: 1 = same source, 2 = re-resolve and
-        failover, 3+ = split the group into per-block fetches."""
+        failover, 3+ = split the group into per-block fetches.
+
+        Runs on a bare timer thread: re-enter the owning tenant's
+        scope so re-issued IO (fault plans, quota charges, downstream
+        allocations) stays attributed to the tenant that started it."""
         with self._lock:
             if self._closed:
                 return  # dead task; the attempt holds no resources
-        if fetch.attempt >= 3 and len(fetch.group.blocks) > 1:
-            self._split_and_refetch(fetch)
-        elif fetch.attempt >= 2:
-            self._failover_refetch(fetch)
-        else:
-            self._fetch_blocks(fetch)
+        with tenancy.tenant_scope(self._tenant):
+            if fetch.attempt >= 3 and len(fetch.group.blocks) > 1:
+                self._split_and_refetch(fetch)
+            elif fetch.attempt >= 2:
+                self._failover_refetch(fetch)
+            else:
+                self._fetch_blocks(fetch)
 
     def _failover_refetch(self, fetch: _PendingFetch) -> None:
         """Re-resolve locations from the driver and re-aim the group.
@@ -629,7 +643,7 @@ class TpuShuffleFetcherIterator:
     def _fetch_blocks(self, fetch: _PendingFetch) -> None:
         """Issue one one-sided READ attempt for a group (:132-218)."""
         mid, group = fetch.manager_id, fetch.group
-        if not self._health.allow(mid.executor_id):
+        if not self._health.allow(mid.executor_id, tenant=self._tenant):
             # open circuit: no READ, no retry ladder — the breaker IS
             # the fail-fast decision for a peer presumed dead, so this
             # surfaces immediately as a FetchFailedError / recompute
@@ -681,7 +695,7 @@ class TpuShuffleFetcherIterator:
                     )
                 )
                 return
-            self._health.record_success(mid.executor_id)
+            self._health.record_success(mid.executor_id, tenant=self._tenant)
             streams: List[Tuple[int, BinaryIO]] = [
                 (pid, MemoryviewInputStream(sl.view, on_close=sl.release))
                 for (pid, _block), sl in zip(group.blocks, slices)
@@ -718,7 +732,7 @@ class TpuShuffleFetcherIterator:
                     )
                 )
                 return
-            self._health.record_success(mid.executor_id)
+            self._health.record_success(mid.executor_id, tenant=self._tenant)
             remaining = [len(delivery.views)]
             lock = threading.Lock()
 
@@ -803,8 +817,10 @@ class TpuShuffleFetcherIterator:
                 fetch = self._pending.pop(0)
                 self._bytes_in_flight += fetch.group.total_length
                 start_now.append(fetch)
-        for fetch in start_now:
-            self._fetch_blocks(fetch)
+        # runs on a completion-callback thread with no scope of its own
+        with tenancy.tenant_scope(self._tenant):
+            for fetch in start_now:
+                self._fetch_blocks(fetch)
 
     def has_next(self) -> bool:
         if self._buffered:

@@ -33,6 +33,8 @@ from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
 from sparkrdma_tpu.obs.telemetry import TelemetryHub
 from sparkrdma_tpu.resilience import SourceHealthRegistry
+from sparkrdma_tpu.tenancy import AdmissionController, FairShareExecutor
+from sparkrdma_tpu.tenancy import quota as _tquota
 from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.utils import checksum as _checksum
 from sparkrdma_tpu.rpc import (
@@ -129,6 +131,19 @@ class TpuShuffleManager:
         # the conf-driven fault plan for reproducible chaos runs
         self.health = SourceHealthRegistry(conf, role=self.executor_id)
         _faults.ensure_installed(conf.fault_plan, conf.fault_plan_seed)
+
+        # tenancy: the driver admits jobs (bounded in-flight + FIFO
+        # queue-with-deadline); every manager installs the process-wide
+        # quota brokers (idempotent — first tenancy-enabled conf wins)
+        self.admission: Optional[AdmissionController] = None
+        if conf.tenancy_enabled:
+            _tquota.install(conf)
+            if is_driver:
+                self.admission = AdmissionController(
+                    conf.tenancy_max_concurrent_jobs,
+                    conf.tenancy_admit_timeout_ms,
+                    role=self.executor_id,
+                )
 
         # cluster telemetry plane: the driver (already the metadata hub
         # for every shuffle) folds executor heartbeats into per-executor
@@ -473,6 +488,12 @@ class TpuShuffleManager:
         if workers <= 1 or len(locations) < 4 * workers:
             return [self._with_checksum(loc) for loc in locations]
         with self._lock:
+            if self._stopped:
+                # create-vs-close race: never spin up a pool that
+                # stop() has already swept past (it would leak)
+                raise RuntimeError(
+                    f"manager {self.executor_id} is stopped; cannot publish"
+                )
             if self._ck_pool is None:
                 self._ck_pool = ThreadPoolExecutor(
                     max_workers=workers,
@@ -618,17 +639,38 @@ class TpuShuffleManager:
         return reader
 
     @property
-    def map_pool(self) -> ThreadPoolExecutor:
+    def map_pool(self):
         """This executor's bounded map-task pool (lazy; size = conf
         ``map.parallelism``). Map dispatch layers (engine/context,
         engine/worker) submit map tasks here so per-executor map
-        concurrency is a config knob, not a scheduler accident."""
+        concurrency is a config knob, not a scheduler accident.
+
+        With tenancy enabled the pool dispatches deficit-round-robin
+        per tenant (FairShareExecutor) instead of FIFO. Creation and
+        the stop() swap share ``_lock`` and creation re-checks
+        ``_stopped`` — a lazy create racing close() can neither leak a
+        live pool past shutdown nor hand one out (post-close access
+        raises instead)."""
         with self._lock:
-            if self._map_pool is None:
-                self._map_pool = ThreadPoolExecutor(
-                    max_workers=self.conf.map_parallelism,
-                    thread_name_prefix=f"map-{self.executor_id}",
+            if self._stopped:
+                raise RuntimeError(
+                    f"manager {self.executor_id} is stopped; map_pool is gone"
                 )
+            if self._map_pool is None:
+                if self.conf.tenancy_enabled:
+                    self._map_pool = FairShareExecutor(
+                        max_workers=self.conf.map_parallelism,
+                        weights=self.conf.tenancy_weights,
+                        default_weight=self.conf.tenancy_default_weight,
+                        quantum_ms=self.conf.tenancy_quantum_ms,
+                        thread_name_prefix=f"map-{self.executor_id}",
+                        pool=f"map-{self.executor_id}",
+                    )
+                else:
+                    self._map_pool = ThreadPoolExecutor(
+                        max_workers=self.conf.map_parallelism,
+                        thread_name_prefix=f"map-{self.executor_id}",
+                    )
             return self._map_pool
 
     def finalize_maps(self, shuffle_id: int) -> None:
@@ -755,6 +797,8 @@ class TpuShuffleManager:
             self._stopped = True
             map_pool, self._map_pool = self._map_pool, None
             ck_pool, self._ck_pool = self._ck_pool, None
+        if self.admission is not None:
+            self.admission.close()  # queued jobs raise AdmissionClosed
         if map_pool is not None:
             map_pool.shutdown(wait=True)
         if ck_pool is not None:

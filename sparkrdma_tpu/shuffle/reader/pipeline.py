@@ -54,6 +54,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
+from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.shuffle.writer.pipeline import PipelineReport, _STAGE_BOUNDS
 
@@ -123,6 +124,9 @@ class ReduceTaskPipeline:
 
     # ------------------------------------------------------------------
     def stream(self, source: Iterable[Any]) -> Iterator[Any]:
+        # fetch/decode/stage/merge run on bare threads: inherit the
+        # consuming task's tenant for buffer charges and breaker keys
+        tenant = tenancy.current_tenant()
         reg = get_registry()
         inflight = reg.gauge("reader.pipeline.inflight", role=self._role)
         hists = {
@@ -325,15 +329,19 @@ class ReduceTaskPipeline:
 
         threads = [
             threading.Thread(
-                target=fetch_main, name="reduce-pipeline-fetch", daemon=True
+                target=tenancy.scoped(tenant, fetch_main),
+                name="reduce-pipeline-fetch",
+                daemon=True,
             ),
             threading.Thread(
-                target=stage_main, name="reduce-pipeline-stage", daemon=True
+                target=tenancy.scoped(tenant, stage_main),
+                name="reduce-pipeline-stage",
+                daemon=True,
             ),
         ]
         threads += [
             threading.Thread(
-                target=decode_main,
+                target=tenancy.scoped(tenant, decode_main),
                 name=f"reduce-pipeline-decode-{i}",
                 daemon=True,
             )
@@ -342,7 +350,9 @@ class ReduceTaskPipeline:
         if self._double_buffer:
             threads.append(
                 threading.Thread(
-                    target=merge_main, name="reduce-pipeline-merge", daemon=True
+                    target=tenancy.scoped(tenant, merge_main),
+                    name="reduce-pipeline-merge",
+                    daemon=True,
                 )
             )
         t_wall0 = time.perf_counter()

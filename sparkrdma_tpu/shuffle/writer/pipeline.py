@@ -44,6 +44,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.obs import get_registry
 
 STAGES = ("sort", "stage", "publish")
@@ -99,6 +100,10 @@ class MapTaskPipeline:
     # ------------------------------------------------------------------
     def run(self, items: Sequence[Any]) -> PipelineReport:
         items = list(items)
+        # the stage/publish threads and sort pool are bare threads: they
+        # must inherit the submitting task's tenant so buffer charges
+        # and breaker keys stay attributed to the right tenant
+        tenant = tenancy.current_tenant()
         reg = get_registry()
         inflight = reg.gauge("writer.pipeline.inflight", role=self._role)
         hists = {
@@ -200,10 +205,14 @@ class MapTaskPipeline:
 
         t_wall0 = time.perf_counter()
         stage_t = threading.Thread(
-            target=stage_main, name="map-pipeline-stage", daemon=True
+            target=tenancy.scoped(tenant, stage_main),
+            name="map-pipeline-stage",
+            daemon=True,
         )
         publish_t = threading.Thread(
-            target=publish_main, name="map-pipeline-publish", daemon=True
+            target=tenancy.scoped(tenant, publish_main),
+            name="map-pipeline-publish",
+            daemon=True,
         )
         stage_t.start()
         publish_t.start()
@@ -211,7 +220,8 @@ class MapTaskPipeline:
             self._parallelism, thread_name_prefix="map-pipeline-sort"
         )
         try:
-            futures = [pool.submit(sort_one, i) for i in range(len(items))]
+            sort_scoped = tenancy.scoped(tenant, sort_one)
+            futures = [pool.submit(sort_scoped, i) for i in range(len(items))]
             for f in futures:
                 f.result()  # sort_one never raises; this is a join
         finally:

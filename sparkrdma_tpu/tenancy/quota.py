@@ -1,0 +1,178 @@
+"""Per-tenant byte quotas with backpressure — mempool and HBM arena.
+
+A broker tracks *held* bytes per tenant for one resource (capacity is
+charged at ``get`` and released at ``put``/``free``, so spilling a
+slab to host does not un-block its tenant — the capacity is still
+owned). ``charge`` blocks the calling thread — i.e. the offending
+tenant's own stage/push worker — while the tenant is at its quota,
+and wakes on any of that tenant's releases. Two hard guarantees:
+
+- **progress**: a tenant holding zero bytes is always admitted, even
+  for a request larger than its quota (a single oversized buffer must
+  not deadlock), and a blocked charge proceeds anyway after
+  ``block_max_ms`` (counted under ``tenant.quota_overruns``) — the
+  quota is backpressure, never an OOM or a permanent wedge;
+- **isolation**: usage is per-tenant, so one tenant at its quota never
+  blocks another's allocations.
+
+Brokers are installed process-wide (the mempool/arena are process
+singletons per node) from the first tenancy-enabled manager init;
+:func:`broker` returns None while unconfigured so the allocation hot
+paths pay nothing when quotas are off.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from sparkrdma_tpu.obs import get_registry
+
+logger = logging.getLogger(__name__)
+
+
+class QuotaBroker:
+    """Byte ledger + backpressure gate for one resource."""
+
+    def __init__(
+        self,
+        resource: str,
+        quota_bytes: int,
+        block_max_ms: int = 60000,
+        per_tenant: Optional[Dict[str, int]] = None,
+    ):
+        self.resource = resource
+        self._quota = max(0, quota_bytes)  # 0 = unlimited
+        self._per_tenant = dict(per_tenant or {})
+        self._block_max_s = max(1, block_max_ms) / 1000.0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._usage: Dict[str, int] = {}
+        reg = get_registry()
+        self._m_blocks = lambda t: reg.counter(
+            "tenant.quota_blocks", tenant=t, resource=resource
+        )
+        self._m_overruns = lambda t: reg.counter(
+            "tenant.quota_overruns", tenant=t, resource=resource
+        )
+        self._h_wait = lambda t: reg.histogram(
+            "tenant.quota_wait_ms", tenant=t, resource=resource
+        )
+        self._g_bytes = lambda t: reg.gauge(
+            "tenant.bytes", tenant=t, resource=resource
+        )
+
+    def quota_for(self, tenant: str) -> int:
+        return self._per_tenant.get(tenant, self._quota)
+
+    def usage(self, tenant: str) -> int:
+        with self._lock:
+            return self._usage.get(tenant, 0)
+
+    def over_quota(self, tenant: str) -> bool:
+        q = self.quota_for(tenant)
+        return q > 0 and self.usage(tenant) > q
+
+    def charge(self, tenant: str, nbytes: int) -> None:
+        """Account nbytes to tenant, blocking at the quota.
+
+        Blocks only while the tenant already holds bytes (progress
+        guarantee) and only the offending tenant's thread — other
+        tenants charge through the same lock without waiting."""
+        quota = self.quota_for(tenant)
+        blocked_at: Optional[float] = None
+        with self._cond:
+            if quota > 0:
+                deadline = None
+                while (
+                    self._usage.get(tenant, 0) > 0
+                    and self._usage.get(tenant, 0) + nbytes > quota
+                ):
+                    now = time.perf_counter()
+                    if blocked_at is None:
+                        blocked_at = now
+                        deadline = now + self._block_max_s
+                        self._m_blocks(tenant).inc()
+                    if now >= deadline:
+                        self._m_overruns(tenant).inc()
+                        logger.warning(
+                            "tenant %s overran its %s quota wait "
+                            "(%.0f ms); admitting %d bytes anyway",
+                            tenant, self.resource,
+                            self._block_max_s * 1e3, nbytes,
+                        )
+                        break
+                    self._cond.wait(deadline - now)
+            self._usage[tenant] = self._usage.get(tenant, 0) + nbytes
+            self._g_bytes(tenant).set(self._usage[tenant])
+        if blocked_at is not None:
+            self._h_wait(tenant).observe(
+                (time.perf_counter() - blocked_at) * 1e3
+            )
+
+    def release(self, tenant: str, nbytes: int) -> None:
+        with self._cond:
+            self._usage[tenant] = max(0, self._usage.get(tenant, 0) - nbytes)
+            self._g_bytes(tenant).set(self._usage[tenant])
+            self._cond.notify_all()
+
+
+# -- process-wide broker table -------------------------------------------
+_table_lock = threading.Lock()
+_brokers: Dict[str, QuotaBroker] = {}
+
+
+def _per_tenant_overrides(conf, resource_key: str) -> Dict[str, int]:
+    """Scan conf for ``tenancy.quota.<tenant>.<resource_key>`` entries."""
+    from sparkrdma_tpu.utils.config import PREFIX
+    from sparkrdma_tpu.utils.units import parse_bytes
+
+    head = PREFIX + "tenancy.quota."
+    tail = "." + resource_key
+    out: Dict[str, int] = {}
+    for key, raw in conf.to_dict().items():
+        if key.startswith(head) and key.endswith(tail):
+            tenant = key[len(head) : -len(tail)]
+            if not tenant:
+                continue
+            try:
+                out[tenant] = parse_bytes(str(raw))
+            except ValueError:
+                continue
+    return out
+
+
+def install(conf) -> None:
+    """Install the mempool/hbm brokers from conf (idempotent; first
+    tenancy-enabled manager in the process wins). A resource with no
+    default quota and no per-tenant override gets NO broker, keeping
+    the allocation hot paths untouched when quotas are off."""
+    specs = {
+        "mempool": (conf.tenancy_mempool_quota_bytes, "mempoolBytes"),
+        "hbm": (conf.tenancy_hbm_quota_bytes, "hbmBytes"),
+    }
+    with _table_lock:
+        for resource, (default_quota, key) in specs.items():
+            if resource in _brokers:
+                continue
+            per_tenant = _per_tenant_overrides(conf, key)
+            if default_quota <= 0 and not per_tenant:
+                continue
+            _brokers[resource] = QuotaBroker(
+                resource,
+                default_quota,
+                block_max_ms=conf.tenancy_quota_block_max_ms,
+                per_tenant=per_tenant,
+            )
+
+
+def broker(resource: str) -> Optional[QuotaBroker]:
+    return _brokers.get(resource)
+
+
+def reset() -> None:
+    """Drop installed brokers (tests only)."""
+    with _table_lock:
+        _brokers.clear()

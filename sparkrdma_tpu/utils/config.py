@@ -541,3 +541,62 @@ class TpuShuffleConf:
         is still RAM; point this at real storage when using
         hbm.hostSpillMaxBytes to protect host memory."""
         return str(self.get(PREFIX + "hbm.spillDir", "") or "")
+
+    # -- tenancy (multi-tenant serving; sparkrdma_tpu/tenancy) ------------
+    @property
+    def tenancy_enabled(self) -> bool:
+        """Serve concurrent jobs through the tenancy layer: admission
+        control on the driver, deficit-round-robin fair-share dispatch
+        on the bounded map/reduce pools, and (when quotas are set)
+        per-tenant byte backpressure. With a single (default) tenant
+        every mechanism degenerates to the pre-tenancy behavior, so
+        this is safe to leave on."""
+        return self._bool("tenancy.enabled", True)
+
+    @property
+    def tenancy_max_concurrent_jobs(self) -> int:
+        """Jobs admitted in-flight before new ones queue (FIFO)."""
+        return self._int("tenancy.maxConcurrentJobs", 8, 1, 4096)
+
+    @property
+    def tenancy_admit_timeout_ms(self) -> int:
+        """Queue-with-deadline: a job still queued after this raises
+        AdmissionTimeout instead of camping on the admission queue."""
+        return self._int("tenancy.admitTimeoutMs", 30000, 1, 1 << 31)
+
+    @property
+    def tenancy_weights(self) -> Dict[str, int]:
+        """Fair-share weights, e.g. ``"alice:4,bob:1"``. Tenants not
+        named get ``tenancy.defaultWeight``."""
+        from sparkrdma_tpu.tenancy import parse_weights
+
+        return parse_weights(str(self.get(PREFIX + "tenancy.weights", "") or ""))
+
+    @property
+    def tenancy_default_weight(self) -> int:
+        return self._int("tenancy.defaultWeight", 1, 1, 1000)
+
+    @property
+    def tenancy_quantum_ms(self) -> int:
+        """DRR credit per round in milliseconds of task runtime (per
+        unit weight). Smaller = finer cross-tenant interleave."""
+        return self._int("tenancy.quantumMs", 20, 1, 60000)
+
+    @property
+    def tenancy_mempool_quota_bytes(self) -> int:
+        """Per-tenant byte quota on held mempool buffers (0 = off).
+        Per-tenant overrides: ``tenancy.quota.<tenant>.mempoolBytes``."""
+        return self._bytes("tenancy.mempoolQuotaBytes", "0", 0, 1 << 44)
+
+    @property
+    def tenancy_hbm_quota_bytes(self) -> int:
+        """Per-tenant byte quota on held HBM-arena capacity (0 = off).
+        Per-tenant overrides: ``tenancy.quota.<tenant>.hbmBytes``."""
+        return self._bytes("tenancy.hbmQuotaBytes", "0", 0, 1 << 44)
+
+    @property
+    def tenancy_quota_block_max_ms(self) -> int:
+        """Upper bound on one quota backpressure stall; past it the
+        charge is admitted anyway (tenant.quota_overruns) — the quota
+        is backpressure, never a wedge."""
+        return self._int("tenancy.quotaBlockMaxMs", 60000, 1, 1 << 31)

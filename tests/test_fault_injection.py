@@ -543,6 +543,89 @@ def test_push_drop_falls_back_to_originals_byte_identical():
     assert out == baseline
 
 
+def test_two_tenant_fault_isolation(monkeypatch):
+    """Tenancy acceptance (DESIGN.md §19): persistent READ faults scoped
+    to ONE tenant's tasks fail that tenant's job — and ONLY that
+    tenant's breakers. A concurrent quiet tenant sharing the same
+    executors, pools, and peers completes correctly, and none of its
+    tenant-scoped breaker keys ever open."""
+    from sparkrdma_tpu import tenancy
+    from sparkrdma_tpu.shuffle.errors import ShuffleError
+
+    state = {"injected": 0}
+    lock = threading.Lock()
+    original = TpuChannel.read_in_queue
+
+    def noisy_only(self, listener, dst_views, blocks):
+        # the read is posted from a tenant-scoped thread (fair-share
+        # worker or the fetcher's re-scoped retry rung), so the current
+        # scope names the owning tenant
+        if tenancy.current_tenant() == "noisy":
+            with lock:
+                state["injected"] += 1
+            listener.on_failure(ChannelError("injected noisy-tenant fault"))
+            return
+        return original(self, listener, dst_views, blocks)
+
+    monkeypatch.setattr(TpuChannel, "read_in_queue", noisy_only)
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.resilience.maxFetchAttempts": "2",
+            "tpu.shuffle.resilience.retryBackoffMs": "5",
+            "tpu.shuffle.resilience.retryBackoffMaxMs": "10",
+            "tpu.shuffle.resilience.circuitFailureThreshold": "2",
+            "tpu.shuffle.resilience.circuitOpenMs": "60000",
+        }
+    )
+    results = {}
+    errors = {}
+
+    with TpuContext(num_executors=2, conf=conf, task_threads=4) as ctx:
+        def job(tenant, n, mod):
+            try:
+                rdd = (
+                    ctx.parallelize(range(n), 4)
+                    .map(lambda x: (x % mod, 1))
+                    .reduce_by_key(lambda a, b: a + b, num_partitions=4)
+                )
+                results[tenant] = dict(ctx.run_job(rdd, tenant=tenant))
+            except Exception as e:  # noqa: BLE001 — inspected below
+                errors[tenant] = e
+
+        threads = [
+            threading.Thread(target=job, args=("noisy", 1200, 5)),
+            threading.Thread(target=job, args=("quiet", 2000, 9)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+
+        # the noisy tenant's job fails (its faults outlast the budget)...
+        assert isinstance(errors.get("noisy"), ShuffleError), (
+            f"noisy tenant should fail with ShuffleError, got {errors}"
+        )
+        assert state["injected"] >= 2
+        # ...while the quiet tenant's concurrent job is untouched
+        assert "quiet" not in errors, f"quiet tenant failed: {errors.get('quiet')}"
+        assert results["quiet"] == {
+            k: len(range(k, 2000, 9)) for k in range(9)
+        }
+        # breaker isolation: noisy-scoped keys opened; every breaker
+        # the quiet tenant touched stays closed
+        states = {}
+        for mgr in ctx.executors:
+            states.update(mgr.health.states())
+        assert any(
+            k.startswith("noisy:") and v == "open" for k, v in states.items()
+        ), f"expected an open noisy-scoped breaker, got {states}"
+        for key, st in states.items():
+            if key.startswith("quiet:") or ":" not in key:
+                assert st == "closed", (
+                    f"fault bled across tenants: breaker {key} is {st}"
+                )
+
+
 def test_push_corrupt_merged_segment_detected_then_fallback():
     """ISSUE acceptance (`push:corrupt:1`): a merged segment corrupted
     AFTER its checksum tag was computed must be caught by the reduce

@@ -1,0 +1,441 @@
+"""Tenancy layer — fair-share dispatch, admission, quotas, isolation.
+
+Proofs for the multi-tenant serving story (docs/DESIGN.md §19): the
+deficit-round-robin pools cannot be convoyed by a large tenant, the
+admission queue bounds in-flight jobs with a deadline, byte quotas
+backpressure the offending tenant without touching its neighbors, and
+the tenant dimension threads through breakers and the obs registry.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.tenancy import (
+    AdmissionController,
+    AdmissionTimeout,
+    FairShareExecutor,
+    QuotaBroker,
+    tenant_scope,
+)
+from sparkrdma_tpu.tenancy import quota as _quota
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+@pytest.fixture(autouse=True)
+def _clean_quota_table():
+    _quota.reset()
+    yield
+    _quota.reset()
+
+
+# ---------------------------------------------------------------------------
+# FairShareExecutor
+# ---------------------------------------------------------------------------
+def test_fairshare_single_tenant_is_fifo():
+    with FairShareExecutor(1) as ex:
+        order = []
+        futs = [ex.submit(lambda i=i: order.append(i)) for i in range(20)]
+        for f in futs:
+            f.result()
+    assert order == list(range(20))
+
+
+def test_fairshare_no_convoy():
+    """A tenant with a huge queue cannot convoy a small tenant: the
+    small tenant's 5 tasks finish while the big queue is still long."""
+    done = []
+    lock = threading.Lock()
+
+    def work(tag):
+        time.sleep(0.002)
+        with lock:
+            done.append(tag)
+
+    ex = FairShareExecutor(1, quantum_ms=4)
+    with tenant_scope("big"):
+        big = [ex.submit(work, ("big", i)) for i in range(80)]
+    with tenant_scope("small"):
+        small = [ex.submit(work, ("small", i)) for i in range(5)]
+    for f in small + big:
+        f.result()
+    ex.shutdown()
+    # under FIFO the small tenant's last completion index would be >= 80;
+    # under DRR it lands well inside the big tenant's drain
+    last_small = max(i for i, tag in enumerate(done) if tag[0] == "small")
+    assert last_small < 40, f"small tenant convoyed: finished at {last_small}"
+
+
+def test_fairshare_weighted_dispatch_ratio():
+    """Weight 3 vs 1 with equal task costs → ~3:1 completions while
+    both stay backlogged."""
+    counts = {"a": 0, "b": 0}
+    lock = threading.Lock()
+
+    def work(t):
+        time.sleep(0.002)
+        with lock:
+            counts[t] += 1
+
+    ex = FairShareExecutor(1, weights={"a": 3, "b": 1}, quantum_ms=4)
+    with tenant_scope("a"):
+        fa = [ex.submit(work, "a") for _ in range(200)]
+    with tenant_scope("b"):
+        fb = [ex.submit(work, "b") for _ in range(200)]
+    # sample while both queues are still backlogged
+    while True:
+        with lock:
+            total = counts["a"] + counts["b"]
+        if total >= 80:
+            break
+        time.sleep(0.005)
+    with lock:
+        a, b = counts["a"], counts["b"]
+    ex.shutdown(wait=False, cancel_futures=True)
+    for f in fa + fb:
+        if not f.cancelled():
+            f.exception()
+    ratio = a / max(1, b)
+    assert 1.8 <= ratio <= 5.0, f"expected ~3:1 dispatch, got {a}:{b}"
+
+
+def test_fairshare_runtime_charging_balances_task_seconds():
+    """Tenant 'slow' runs 4x-longer tasks at equal weight: DRR charged
+    by measured runtime should push its completed-task COUNT to ~1/4
+    of 'fast', keeping task-seconds near parity."""
+    counts = {"slow": 0, "fast": 0}
+    lock = threading.Lock()
+
+    def work(t, dt):
+        time.sleep(dt)
+        with lock:
+            counts[t] += 1
+
+    ex = FairShareExecutor(1, quantum_ms=4)
+    with tenant_scope("slow"):
+        fs = [ex.submit(work, "slow", 0.008) for _ in range(100)]
+    with tenant_scope("fast"):
+        ff = [ex.submit(work, "fast", 0.002) for _ in range(100)]
+    while True:
+        with lock:
+            secs_slow = counts["slow"] * 0.008
+            secs_fast = counts["fast"] * 0.002
+        if secs_slow + secs_fast >= 0.25:
+            break
+        time.sleep(0.005)
+    ex.shutdown(wait=False, cancel_futures=True)
+    for f in fs + ff:
+        if not f.cancelled():
+            f.exception()
+    assert secs_fast > 0 and secs_slow > 0
+    share = secs_slow / (secs_slow + secs_fast)
+    assert 0.25 <= share <= 0.75, (
+        f"task-seconds skewed: slow={secs_slow:.3f}s fast={secs_fast:.3f}s"
+    )
+
+
+def test_fairshare_post_shutdown_submit_raises():
+    ex = FairShareExecutor(1)
+    ex.shutdown()
+    with pytest.raises(RuntimeError):
+        ex.submit(lambda: None)
+
+
+def test_fairshare_propagates_exceptions_and_tenant_scope():
+    seen = {}
+
+    def work():
+        seen["tenant"] = tenancy.current_tenant()
+        raise ValueError("boom")
+
+    with FairShareExecutor(2) as ex:
+        with tenant_scope("alice"):
+            f = ex.submit(work)
+        with pytest.raises(ValueError):
+            f.result()
+    assert seen["tenant"] == "alice"  # workers re-enter the submit scope
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController
+# ---------------------------------------------------------------------------
+def test_admission_bounds_inflight_and_deadline():
+    ac = AdmissionController(max_inflight=2, queue_timeout_ms=30_000)
+    ac.acquire("a")
+    ac.acquire("a")
+    assert ac.inflight == 2
+    with pytest.raises(AdmissionTimeout):
+        ac.acquire("b", timeout_ms=50)
+    ac.release()
+    ac.acquire("b", timeout_ms=1000)  # capacity freed → admitted
+    assert ac.inflight == 2
+    ac.release()
+    ac.release()
+    assert ac.inflight == 0
+
+
+def test_admission_queue_is_fifo():
+    ac = AdmissionController(max_inflight=1, queue_timeout_ms=30_000)
+    ac.acquire("t0")
+    order = []
+    lock = threading.Lock()
+
+    def queued(name):
+        ac.acquire(name, timeout_ms=10_000)
+        with lock:
+            order.append(name)
+        ac.release()
+
+    threads = []
+    for name in ("t1", "t2", "t3"):
+        t = threading.Thread(target=queued, args=(name,), daemon=True)
+        t.start()
+        threads.append(t)
+        # let each waiter enqueue before the next (FIFO order fixed)
+        deadline = time.monotonic() + 5
+        while ac.queued < len(threads) and time.monotonic() < deadline:
+            time.sleep(0.005)
+    ac.release()
+    for t in threads:
+        t.join(timeout=10)
+    assert order == ["t1", "t2", "t3"]
+
+
+# ---------------------------------------------------------------------------
+# QuotaBroker
+# ---------------------------------------------------------------------------
+def test_quota_blocks_offender_not_neighbors():
+    br = QuotaBroker("mempool", quota_bytes=100, block_max_ms=60_000)
+    br.charge("a", 80)
+    blocked = threading.Event()
+    passed = threading.Event()
+
+    def offender():
+        blocked.set()
+        br.charge("a", 80)  # over quota while holding bytes → waits
+        passed.set()
+
+    t = threading.Thread(target=offender, daemon=True)
+    t.start()
+    blocked.wait(5)
+    time.sleep(0.05)
+    assert not passed.is_set(), "over-quota charge should block"
+    # a neighbor at the same instant sails through
+    t0 = time.perf_counter()
+    br.charge("b", 80)
+    assert time.perf_counter() - t0 < 0.5
+    br.release("b", 80)
+    # releasing the offender's held bytes unblocks it
+    br.release("a", 80)
+    assert passed.wait(5), "release did not unblock the offender"
+    t.join(timeout=5)
+    assert br.usage("a") == 80
+
+
+def test_quota_progress_guarantees():
+    br = QuotaBroker("hbm", quota_bytes=100, block_max_ms=100)
+    # oversize first allocation admits immediately (usage == 0)
+    t0 = time.perf_counter()
+    br.charge("a", 500)
+    assert time.perf_counter() - t0 < 0.5
+    # held-and-over-quota blocks, but only until block_max_ms
+    t0 = time.perf_counter()
+    br.charge("a", 50)
+    dt = time.perf_counter() - t0
+    assert 0.05 <= dt < 2.0, f"expected ~100ms bounded stall, got {dt:.3f}s"
+    snap = get_registry().snapshot(prefix="tenant.quota_overruns")
+    assert sum(snap.get("counters", {}).values()) >= 1
+
+
+def test_mempool_quota_integration():
+    from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
+    from sparkrdma_tpu.memory.registry import ProtectionDomain
+
+    conf = TpuShuffleConf({"tpu.shuffle.tenancy.mempoolQuotaBytes": "32k"})
+    _quota.install(conf)
+    mgr = TpuBufferManager(ProtectionDomain())
+    with tenant_scope("hog"):
+        b1 = mgr.get(16 * 1024)
+        b2 = mgr.get(16 * 1024)  # at quota now (2 × 16 KiB classes)
+    blocked = threading.Event()
+    passed = threading.Event()
+    grabbed = []
+
+    def hog_more():
+        with tenant_scope("hog"):
+            blocked.set()
+            grabbed.append(mgr.get(16 * 1024))
+            passed.set()
+
+    t = threading.Thread(target=hog_more, daemon=True)
+    t.start()
+    blocked.wait(5)
+    time.sleep(0.05)
+    assert not passed.is_set(), "third 16k buffer should block at the 32k quota"
+    with tenant_scope("quiet"):
+        q = mgr.get(16 * 1024)  # neighbor unaffected
+        mgr.put(q)
+    mgr.put(b1)  # frees 16k of 'hog' → the blocked get proceeds
+    assert passed.wait(5)
+    t.join(timeout=5)
+    mgr.put(b2)
+    mgr.put(grabbed[0])
+    broker = _quota.broker("mempool")
+    assert broker is not None and broker.usage("hog") == 0
+    mgr.stop()
+
+
+def test_buffer_free_releases_quota_charge():
+    from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
+    from sparkrdma_tpu.memory.registry import ProtectionDomain
+
+    conf = TpuShuffleConf({"tpu.shuffle.tenancy.mempoolQuotaBytes": "64k"})
+    _quota.install(conf)
+    mgr = TpuBufferManager(ProtectionDomain())
+    with tenant_scope("t"):
+        buf = mgr.get(16 * 1024)
+    buf.free()  # bypasses put(): the tag must still release
+    assert _quota.broker("mempool").usage("t") == 0
+    mgr.stop()
+
+
+def test_hbm_spill_prefers_over_quota_tenant():
+    from sparkrdma_tpu.ops.hbm_arena import DeviceBufferManager
+
+    # per-tenant override: only 'hog' is capped; 'quiet' stays unlimited
+    conf = TpuShuffleConf({"tpu.shuffle.tenancy.quota.hog.hbmBytes": "16k"})
+    _quota.install(conf)
+    # budget fits two 64k slabs; the third forces a spill
+    mgr = DeviceBufferManager(max_bytes=128 * 1024)
+    with tenant_scope("quiet"):
+        old = mgr.get(64 * 1024)  # oldest → the LRU victim by age
+    with tenant_scope("hog"):
+        hogged = mgr.get(64 * 1024)  # 64k held vs 16k quota → over
+    with tenant_scope("quiet"):
+        newer = mgr.get(64 * 1024)  # needs room: must evict 'hog', not LRU
+    assert hogged.spilled, "over-quota tenant's slab should be the victim"
+    assert not old.spilled, "in-quota LRU slab wrongly chosen over offender"
+    for b in (old, hogged, newer):
+        mgr.put(b)
+    mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# manager pool lifecycle (create-vs-close race)
+# ---------------------------------------------------------------------------
+def test_manager_map_pool_post_close_raises():
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf()
+    mgr = TpuShuffleManager(conf, is_driver=True)
+    pool = mgr.map_pool
+    assert pool is not None
+    mgr.stop()
+    with pytest.raises(RuntimeError):
+        _ = mgr.map_pool
+    # the pre-stop pool handle is shut down too: submits raise
+    with pytest.raises(RuntimeError):
+        pool.submit(lambda: None)
+
+
+def test_manager_pool_create_close_race_never_leaks():
+    """Hammer lazy map_pool creation against stop(): afterwards the
+    manager must hold NO pool and every obtained pool must be dead."""
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    for _ in range(15):
+        conf = TpuShuffleConf()
+        mgr = TpuShuffleManager(conf, is_driver=True)
+        obtained = []
+        start = threading.Barrier(3)
+
+        def grab():
+            start.wait()
+            try:
+                obtained.append(mgr.map_pool)
+            except RuntimeError:
+                pass  # post-close access: the clean outcome
+
+        def close():
+            start.wait()
+            mgr.stop()
+
+        threads = [
+            threading.Thread(target=grab),
+            threading.Thread(target=grab),
+            threading.Thread(target=close),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert mgr._map_pool is None
+        for pool in obtained:
+            with pytest.raises(RuntimeError):
+                pool.submit(lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# breaker scoping + e2e labels
+# ---------------------------------------------------------------------------
+def test_breaker_keys_scoped_per_tenant():
+    from sparkrdma_tpu.resilience import SourceHealthRegistry
+
+    conf = TpuShuffleConf({"tpu.shuffle.resilience.circuitFailureThreshold": 2})
+    health = SourceHealthRegistry(conf, role="t")
+    with tenant_scope("noisy"):
+        health.record_failure("exec-1")
+        health.record_failure("exec-1")
+        assert not health.allow("exec-1")
+    # same peer, different tenant: separate breaker, still closed
+    with tenant_scope("quiet"):
+        assert health.allow("exec-1")
+    assert health.allow("exec-1")  # default tenant uses the bare key
+    states = health.states()
+    assert states.get("noisy:exec-1") == "open"
+    assert "quiet:exec-1" in states and states["quiet:exec-1"] == "closed"
+
+
+def test_two_tenant_concurrent_jobs_correct_and_labeled():
+    from sparkrdma_tpu.engine.context import TpuContext
+
+    reg = get_registry()
+    before = reg.snapshot(prefix="admission.admitted")
+    conf = TpuShuffleConf({"tpu.shuffle.tenancy.weights": "alice:2,bob:1"})
+    results = {}
+    errors = []
+    with TpuContext(num_executors=2, conf=conf, task_threads=4) as ctx:
+        def job(tenant, n, mod):
+            try:
+                rdd = (
+                    ctx.parallelize(range(n), 4)
+                    .map(lambda x: (x % mod, 1))
+                    .reduce_by_key(lambda a, b: a + b, num_partitions=4)
+                )
+                results[tenant] = dict(ctx.run_job(rdd, tenant=tenant))
+            except Exception as e:  # noqa: BLE001
+                errors.append((tenant, e))
+
+        threads = [
+            threading.Thread(target=job, args=("alice", 3000, 7)),
+            threading.Thread(target=job, args=("bob", 600, 5)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    assert not errors, errors
+    assert results["alice"] == {k: len(range(k, 3000, 7)) for k in range(7)}
+    assert results["bob"] == {k: len(range(k, 600, 5)) for k in range(5)}
+    snap = reg.snapshot()
+    admitted = reg.delta(before, prefix="admission.admitted")["counters"]
+    assert admitted.get("admission.admitted{tenant=alice}", 0) >= 1
+    assert admitted.get("admission.admitted{tenant=bob}", 0) >= 1
+    task_keys = [k for k in snap["histograms"] if k.startswith("tenant.task_ms")]
+    assert any("tenant=alice" in k for k in task_keys)
+    assert any("tenant=bob" in k for k in task_keys)
+    engine_keys = [k for k in snap["histograms"] if k.startswith("engine.task_ms")]
+    assert any("tenant=alice" in k for k in engine_keys)
