@@ -1,0 +1,57 @@
+"""pytest plugin: run the suite under the lock-order detector.
+
+Registered by ``tests/conftest.py``; armed only when
+``SPARKRDMA_LOCK_ORDER`` is truthy in the environment, so the default
+tier-1 run pays nothing. Under the flag, every ``named_lock`` in the
+library records acquisition-order edges while the tests exercise the
+real concurrency paths, and any violation — order cycle, same-name
+nesting, blocking call under a hot lock — fails the session even when
+every individual test passed.
+"""
+
+from __future__ import annotations
+
+import os
+
+from sparkrdma_tpu.analysis import lockorder
+
+_armed = False
+
+
+def _flag() -> bool:
+    return os.environ.get("SPARKRDMA_LOCK_ORDER", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def pytest_configure(config):
+    global _armed
+    if _flag() and not _armed:
+        _armed = True
+        lockorder.default.reset()
+        lockorder.default.enable()
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _armed:
+        return
+    det = lockorder.default
+    tr = terminalreporter
+    if det.violations:
+        tr.section("lock-order violations")
+        for v in det.violations:
+            tr.line(v)
+    else:
+        edges = sum(len(s) for s in det.edges.values())
+        tr.section("lock-order")
+        tr.line(
+            f"clean: {len(det.edges)} lock names, {edges} order edges, "
+            "0 violations"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _armed and lockorder.default.violations:
+        # mutate the session's exit status so CI fails even when every
+        # individual test passed
+        session.exitstatus = 1

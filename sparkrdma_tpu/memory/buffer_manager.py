@@ -22,6 +22,7 @@ import threading
 from collections import deque
 from typing import Deque, Dict, Optional
 
+from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.memory.buffer import TpuBuffer
 from sparkrdma_tpu.memory.registry import ProtectionDomain
 from sparkrdma_tpu.obs import get_registry
@@ -67,7 +68,8 @@ class _AllocatorStack:
         self.length = length
         self.stack: Deque[TpuBuffer] = deque()
         self.total_alloc = 0
-        self.lock = threading.Lock()
+        # hot: pop/append only; allocation itself happens outside
+        self.lock = named_lock("mempool.stack", hot=True)
         self.closed = False
 
     def get(self) -> TpuBuffer:
@@ -106,7 +108,9 @@ class TpuBufferManager:
     ):
         self.pd = pd
         self._stacks: Dict[int, _AllocatorStack] = {}
-        self._lock = threading.Lock()
+        # hot: guards the size-class table only, never held across
+        # registration or frees
+        self._lock = named_lock("mempool.manager", hot=True)
         self._stopped = False
         # Preallocation of aggregation-block buffers on executors
         # (reference :84-91).

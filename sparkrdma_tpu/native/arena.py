@@ -16,7 +16,17 @@ from typing import Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "arena.cpp")
-_SO = os.path.join(_HERE, "_libsrt_arena.so")
+
+# same SPARKRDMA_NATIVE_SANITIZE contract as transport_lib.py: build a
+# sanitizer-instrumented .so under its own cache name
+from sparkrdma_tpu.native.transport_lib import _SANITIZE, _build_flags  # noqa: E402
+
+_SO = os.path.join(
+    _HERE,
+    "_libsrt_arena.%s.so" % _SANITIZE.replace(",", "-").replace("=", "_")
+    if _SANITIZE
+    else "_libsrt_arena.so",
+)
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -35,7 +45,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 os.path.exists(_SRC) and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
             ):
                 subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC],
+                    ["g++", *_build_flags(), "-o", _SO, _SRC],
                     check=True,
                     capture_output=True,
                 )

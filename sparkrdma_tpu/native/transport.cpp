@@ -67,6 +67,35 @@
 
 namespace {
 
+// A pthread_cond_timedwait that TIMES OUT corrupts this toolchain's
+// TSan lock model (gcc-10 libtsan + glibc 2.31): the interceptor loses
+// the waiter's internal release/reacquire, and from then on every
+// operation on that mutex reports phantom double-locks and data races
+// (reproduced with a 30-line provably-correct producer/consumer — the
+// phantoms track cv.wait_for timeouts exactly and vanish with untimed
+// waits). Under TSan, emulate the timed predicate wait with short
+// untimed sleeps taken OUTSIDE the lock: identical semantics, wake
+// latency bounded by the slice, and the instrumented build stays
+// phantom-free so real races fail the CI job loudly.
+template <class Pred>
+bool cv_wait_ms(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+                int64_t timeout_ms, Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  (void)cv;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (!pred()) {
+    if (std::chrono::steady_clock::now() >= deadline) return pred();
+    lk.unlock();
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    lk.lock();
+  }
+  return true;
+#else
+  return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+#endif
+}
+
 constexpr uint8_t OP_SEND = 1;
 constexpr uint8_t OP_READ_REQ = 2;
 constexpr uint8_t OP_READ_RESP = 3;
@@ -1827,7 +1856,7 @@ int srt_dereg(void* np, uint32_t mkey) {
   // is guaranteed instead: a live loop either drains the pins, or the
   // EVICT below kills the holding conns (unpinning), or STOP's
   // fail-all-conns unpins; each path erases the region and notifies.
-  if (!n->reg_cv.wait_for(lk, std::chrono::seconds(5), gone)) {
+  if (!cv_wait_ms(n->reg_cv, lk, 5000, gone)) {
     lk.unlock();
     Command cmd;
     cmd.kind = Command::EVICT_MKEY;
@@ -1835,7 +1864,7 @@ int srt_dereg(void* np, uint32_t mkey) {
     n->enqueue(std::move(cmd));
     lk.lock();
   }
-  if (!n->reg_cv.wait_for(lk, std::chrono::seconds(30), gone)) {
+  if (!cv_wait_ms(n->reg_cv, lk, 30000, gone)) {
     // loop thread dead or wedged: leak the region entry rather than
     // risk a use-after-free. dereg_wanted stays set, so no future
     // serve can resolve this mkey.
@@ -2053,8 +2082,7 @@ int srt_poll_cq(void* np, srt_comp_c* out, int max, int timeout_ms) {
   Node* n = (Node*)np;
   std::unique_lock<std::mutex> lk(n->cq_mu);
   if (n->cq.empty()) {
-    n->cq_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                      [&] { return !n->cq.empty(); });
+    cv_wait_ms(n->cq_cv, lk, timeout_ms, [&] { return !n->cq.empty(); });
   }
   int k = 0;
   while (k < max && !n->cq.empty()) {

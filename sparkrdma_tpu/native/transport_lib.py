@@ -17,7 +17,30 @@ from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "transport.cpp")
-_SO = os.path.join(_HERE, "_libsrt_transport.so")
+
+# SPARKRDMA_NATIVE_SANITIZE="thread,undefined" rebuilds the plane with
+# -fsanitize=... into a separately cached .so (the CI native-tsan job;
+# see docs/ANALYSIS.md). TSan-instrumented objects need the runtime
+# loaded first: run under LD_PRELOAD=$(g++ -print-file-name=libtsan.so)
+# or dlopen dies allocating static TLS.
+_SANITIZE = os.environ.get("SPARKRDMA_NATIVE_SANITIZE", "").strip()
+
+
+def _so_path(base: str) -> str:
+    if _SANITIZE:
+        tag = _SANITIZE.replace(",", "-").replace("=", "_")
+        return os.path.join(_HERE, f"{base}.{tag}.so")
+    return os.path.join(_HERE, f"{base}.so")
+
+
+def _build_flags() -> list:
+    flags = ["-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+    if _SANITIZE:
+        flags += [f"-fsanitize={_SANITIZE}", "-fno-sanitize-recover=all", "-g"]
+    return flags
+
+
+_SO = _so_path("_libsrt_transport")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -61,10 +84,7 @@ def load() -> Optional[ctypes.CDLL]:
                 and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
             ):
                 subprocess.run(
-                    [
-                        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                        "-pthread", "-o", _SO, _SRC,
-                    ],
+                    ["g++", *_build_flags(), "-o", _SO, _SRC],
                     check=True,
                     capture_output=True,
                 )

@@ -33,6 +33,147 @@ DEFAULT_BOUNDS: Tuple[float, ...] = (
     1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000,
 )
 
+# -- declared metric families ---------------------------------------------
+# name -> (kind, frozenset of label keys). The single source of truth
+# the metric-families analysis pass checks every library call site
+# against (sparkrdma_tpu/analysis/metrics_pass.py): an undeclared name,
+# a kind mismatch, or a label set that drops/invents a key fails the
+# lint. Every family listed here must have an anchor in
+# docs/OBSERVABILITY.md. Tests may mint ad-hoc instruments freely.
+_L = frozenset
+METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
+    # admission control (tenancy/admission.py)
+    "admission.admitted": ("counter", _L({"tenant"})),
+    "admission.queue_waits": ("counter", _L({"tenant"})),
+    "admission.timeouts": ("counter", _L({"tenant"})),
+    "admission.wait_ms": ("histogram", _L({"tenant"})),
+    "admission.inflight": ("gauge", _L({"role"})),
+    "admission.queue_depth": ("gauge", _L({"role"})),
+    # device fetch plane (shuffle/device_fetch.py, device_io.py)
+    "device_fetch.bytes": ("counter", _L()),
+    "device_fetch.stage_ms": ("histogram", _L()),
+    "device_fetch.transport_ms": ("histogram", _L()),
+    "device_fetch.plane.bytes": ("counter", _L({"role"})),
+    "device_fetch.plane.fallbacks": ("counter", _L({"role"})),
+    "device_fetch.plane.pulls": ("counter", _L({"role"})),
+    "device_fetch.plane.plan_ms": ("histogram", _L({"role"})),
+    # engine (engine/)
+    "engine.stage_recomputes": ("counter", _L()),
+    "engine.task_ms": ("histogram", _L({"kind", "role", "tenant"})),
+    # device exchange plane (ops/)
+    "exchange.exchanges": ("counter", _L({"schedule"})),
+    "exchange.bytes_sent": ("counter", _L({"schedule"})),
+    "exchange.bytes_received": ("counter", _L({"schedule"})),
+    "exchange.bytes_received_valid": ("counter", _L({"schedule"})),
+    "exchange.time_ms": ("histogram", _L({"schedule"})),
+    # HBM arena (ops/hbm_arena.py)
+    "hbm.pool_hits": ("counter", _L()),
+    "hbm.pool_misses": ("counter", _L()),
+    "hbm.spill_victims": ("counter", _L()),
+    "hbm.disk_spills": ("counter", _L()),
+    "hbm.in_use_bytes": ("gauge", _L()),
+    # registered-buffer pool (memory/)
+    "mempool.hits": ("counter", _L()),
+    "mempool.misses": ("counter", _L()),
+    "mempool.returns": ("counter", _L()),
+    "mempool.frees": ("counter", _L()),
+    "mempool.registrations": ("counter", _L()),
+    "mempool.deregistrations": ("counter", _L()),
+    "mempool.in_use_bytes": ("gauge", _L()),
+    # adaptive partition planner (shuffle/planner.py)
+    "planner.splits": ("counter", _L({"role"})),
+    "planner.coalesces": ("counter", _L({"role"})),
+    "planner.plan_ms": ("histogram", _L({"role"})),
+    # push-based merge (shuffle/merge.py)
+    "push.pushed_blocks": ("counter", _L({"role"})),
+    "push.pushed_bytes": ("counter", _L({"role"})),
+    "push.merged_bytes": ("counter", _L({"role"})),
+    "push.merge_segments": ("counter", _L({"role"})),
+    "push.budget_drops": ("counter", _L({"role"})),
+    "push.dedup_drops": ("counter", _L({"role"})),
+    "push.dropped": ("counter", _L({"role"})),
+    "push.fallbacks": ("counter", _L({"role"})),
+    "push.send_errors": ("counter", _L({"role"})),
+    "push.skipped": ("counter", _L({"role"})),
+    # reduce/reader plane (shuffle/reader/)
+    "reader.local_blocks": ("counter", _L({"role"})),
+    "reader.local_bytes": ("counter", _L({"role"})),
+    "reader.remote_blocks": ("counter", _L({"role"})),
+    "reader.remote_bytes": ("counter", _L({"role"})),
+    "reader.merged_reads": ("counter", _L({"role"})),
+    "reader.fetch_wait_ms": ("counter", _L({"role"})),
+    "reader.fetch_ms": ("histogram", _L({"role"})),
+    "reader.remote_fetch_ms": ("histogram", _L({"peer"})),
+    "reader.inflight_bytes": ("gauge", _L({"role"})),
+    "reader.pipeline.inflight": ("gauge", _L({"role"})),
+    "reader.pipeline.stage_ms": ("histogram", _L({"role", "stage"})),
+    "reader.pipeline.overlap_ms": ("histogram", _L({"role"})),
+    # resilience ladder (shuffle/fetcher.py, resilience.py)
+    "resilience.retries": ("counter", _L({"role"})),
+    "resilience.failovers": ("counter", _L({"role"})),
+    "resilience.splits": ("counter", _L({"role"})),
+    "resilience.checksum_failures": ("counter", _L({"role"})),
+    "resilience.circuit_open": ("counter", _L({"role"})),
+    "resilience.circuit_close": ("counter", _L({"role"})),
+    "resilience.circuit_fail_fast": ("counter", _L({"role"})),
+    "resilience.straggler_advisories": ("counter", _L({"role"})),
+    # control-plane RPC (shuffle/manager.py)
+    "rpc.messages": ("counter", _L({"role", "type"})),
+    "rpc.errors": ("counter", _L({"role"})),
+    "rpc.handle_ms": ("histogram", _L({"role", "type"})),
+    # cluster telemetry plane (obs/telemetry.py)
+    "telemetry.heartbeats": ("counter", _L({"executor", "role"})),
+    "telemetry.bad_payloads": ("counter", _L({"role"})),
+    "telemetry.executors": ("gauge", _L({"role"})),
+    "telemetry.missed_heartbeats": ("gauge", _L({"role"})),
+    "telemetry.straggler": ("gauge", _L({"executor", "role"})),
+    "telemetry.stragglers": ("gauge", _L({"role"})),
+    # tenancy: fair share + quotas (tenancy/)
+    "tenant.submits": ("counter", _L({"tenant", "pool"})),
+    "tenant.tasks": ("counter", _L({"tenant", "pool"})),
+    "tenant.task_ms": ("histogram", _L({"tenant", "pool"})),
+    "tenant.wait_ms": ("histogram", _L({"tenant", "pool"})),
+    "tenant.queued": ("gauge", _L({"tenant", "pool"})),
+    "tenant.quota_blocks": ("counter", _L({"resource", "tenant"})),
+    "tenant.quota_overruns": ("counter", _L({"resource", "tenant"})),
+    "tenant.quota_wait_ms": ("histogram", _L({"resource", "tenant"})),
+    "tenant.bytes": ("gauge", _L({"resource", "tenant"})),
+    # host transport (transport/)
+    "transport.connects": ("counter", _L({"purpose"})),
+    "transport.connect_retries": ("counter", _L({"purpose"})),
+    "transport.accepts": ("counter", _L({"purpose"})),
+    "transport.completions": ("counter", _L({"purpose"})),
+    "transport.errors_latched": ("counter", _L({"purpose"})),
+    "transport.sends": ("counter", _L({"purpose"})),
+    "transport.send_bytes": ("counter", _L({"purpose"})),
+    "transport.send_overflow": ("counter", _L({"purpose"})),
+    "transport.recvs": ("counter", _L({"purpose"})),
+    "transport.recv_bytes": ("counter", _L({"purpose"})),
+    "transport.reads": ("counter", _L({"purpose"})),
+    "transport.read_bytes": ("counter", _L({"purpose"})),
+    "transport.reads_served": ("counter", _L({"purpose"})),
+    "transport.read_bytes_served": ("counter", _L({"purpose"})),
+    "transport.read_errors": ("counter", _L({"purpose"})),
+    # map/writer plane (shuffle/writer/)
+    "writer.map_outputs": ("counter", _L({"method", "role"})),
+    "writer.bytes_written": ("counter", _L({"role"})),
+    "writer.flush_bytes": ("counter", _L({"role"})),
+    "writer.partition_flushes": ("counter", _L({"role"})),
+    "writer.partitions_written": ("counter", _L({"role"})),
+    "writer.publishes": ("counter", _L({"role"})),
+    "writer.incremental_publishes": ("counter", _L({"role"})),
+    "writer.locations_published": ("counter", _L({"role"})),
+    "writer.blocks_memory": ("counter", _L()),
+    "writer.blocks_spilled": ("counter", _L()),
+    "writer.spill_bytes": ("counter", _L()),
+    "writer.chunk_allocations": ("counter", _L()),
+    "writer.chunk_recycles": ("counter", _L()),
+    "writer.pipeline.inflight": ("gauge", _L({"role"})),
+    "writer.pipeline.stage_ms": ("histogram", _L({"role", "stage"})),
+    "writer.pipeline.overlap_ms": ("histogram", _L({"role"})),
+}
+del _L
+
 
 def metric_key(name: str, labels: Mapping[str, str]) -> str:
     """Canonical snapshot key: ``name`` or ``name{k=v,...}`` (sorted)."""
@@ -216,7 +357,11 @@ class MetricsRegistry:
     """Thread-safe get-or-create registry of named, labeled instruments."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # hot: held for dict lookups only, every layer's instrument
+        # resolution goes through it (lock-order detector, docs/ANALYSIS.md)
+        from sparkrdma_tpu.analysis.lockorder import named_lock
+
+        self._lock = named_lock("metrics.registry", hot=True)
         self._metrics: Dict[str, object] = {}
 
     def _get_or_create(self, cls, name: str, labels: Mapping[str, str],
@@ -318,6 +463,37 @@ class MetricsRegistry:
                         m._sum = 0.0
                         m._min = None
                         m._max = None
+
+
+    def family_violations(self) -> List[str]:
+        """Registered instruments that contradict METRIC_FAMILIES.
+
+        The runtime complement of the static metric-families lint: it
+        sees instruments minted through dynamic helpers (e.g. the
+        fair-share executor's cached ``getattr(reg, kind)`` factories)
+        that no AST pass can. Undeclared names are ignored — tests mint
+        ad-hoc instruments freely; only declared families are held to
+        their kind and label set."""
+        kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+        out: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            fam = METRIC_FAMILIES.get(m.name)
+            if fam is None:
+                continue
+            kind, labels = fam
+            if kinds[type(m)] != kind:
+                out.append(
+                    f"{m.name}: registered as {kinds[type(m)]}, "
+                    f"declared {kind}"
+                )
+            if frozenset(m.labels) != labels:
+                out.append(
+                    f"{m.name}: label set {sorted(m.labels)} != "
+                    f"declared {sorted(labels)}"
+                )
+        return out
 
 
 _DEFAULT = MetricsRegistry()

@@ -36,8 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.obs import get_registry
-from sparkrdma_tpu.tenancy import current_tenant
+from sparkrdma_tpu.tenancy import current_tenant, tenant_scope
 from sparkrdma_tpu.tenancy import quota as _quota
 
 logger = logging.getLogger(__name__)
@@ -96,7 +97,12 @@ class DeviceBuffer:
         #  - cascades run with NO buffer lock held;
         #  - victim picks (the only cross-thread acquisition) never
         #    target pinned buffers, and every climber pins itself.
-        self._tier_lock = threading.Lock()
+        #  allow_self_nest: a climber legitimately holds its own tier
+        #  lock while spilling an unpinned victim (_make_room /
+        #  _cascade_host_tier) — safe because the climber is pinned and
+        #  victim picks exclude pinned handles, so the inner lock can
+        #  never belong to a thread's own outer buffer
+        self._tier_lock = named_lock("hbm.buffer", allow_self_nest=True)
         self.last_use = 0
 
     @property
@@ -332,8 +338,8 @@ class DeviceBufferManager:
         self._allocating = 0
         # waiters in _make_room blocked on pinned residents; notified on
         # any pin drop or budget release
-        self._evict_cond = threading.Condition()
-        self._lock = threading.Lock()
+        self._evict_cond = threading.Condition(named_lock("hbm.evict"))
+        self._lock = named_lock("hbm.manager")
         self._stopped = False
         # optional warm-up (reference maxAggPrealloc, RdmaBufferManager.java:84-91)
         if prealloc > 0 and prealloc_size > 0:
@@ -599,14 +605,19 @@ class DeviceBufferManager:
         effort: under budget pressure later traffic may re-spill."""
         bufs = list(bufs)
         done = threading.Event()
+        # the climb re-spills victims and re-charges restores under the
+        # CALLER's tenant, so the background thread must re-enter its
+        # scope — otherwise the work bills the default tenant
+        tenant = current_tenant()
 
         def run():
-            try:
-                self.ensure_device_all(bufs)
-            except Exception:
-                logger.exception("hbm prefetch pass failed")
-            finally:
-                done.set()
+            with tenant_scope(tenant):
+                try:
+                    self.ensure_device_all(bufs)
+                except Exception:
+                    logger.exception("hbm prefetch pass failed")
+                finally:
+                    done.set()
 
         threading.Thread(target=run, daemon=True, name="hbm-prefetch").start()
         return done

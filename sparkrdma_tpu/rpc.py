@@ -112,6 +112,11 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # is_last(1) shuffle_id(4) partition_id(4) num_map_outputs(4)
     _HDR = struct.Struct(">Biii")
     _TRACE_EXT = struct.Struct(">Q")
+    # ONE header shape for every trailing extension: marker(2) count(4).
+    # The parser peeks exactly this many bytes to dispatch, so all
+    # extensions MUST share it — encoder and parser both go through
+    # _EXT_HDR (the wire-markers analysis pass enforces the pairing).
+    _EXT_HDR = struct.Struct(">HI")
     # per-segment checksum extension (resilience layer): written AFTER
     # the locations, BEFORE the trace extension. The marker 0xFFFF is
     # impossible as a ShuffleManagerId host length (a 64 KiB hostname
@@ -119,44 +124,38 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # distinguishes "next location" from "checksum extension"
     # unambiguously; examples/foreign_client.c's bounds check
     # (``o + hl + 4 + 2 > n``) makes the marker terminate its parse
-    # loop safely. Layout: marker(2) count(4), then per location
+    # loop safely. Layout: _EXT_HDR, then per location
     # algo(1) crc(4) — algo-tagged so mixed publishers coexist
     # (utils/checksum.py).
     _CK_MARKER = 0xFFFF
-    _CK_HDR = struct.Struct(">HI")
     _CK_ITEM = struct.Struct(">BI")
     # per-segment device-location extension (device fetch plane):
     # written AFTER the checksum extension, BEFORE the trace extension.
     # Same marker trick with 0xFFFE — equally impossible as a host
-    # length — and the header deliberately shares _CK_HDR's 6-byte
-    # (marker, count) shape so the single peek below disambiguates both
-    # extensions. Layout: marker(2) count(4), then per location
+    # length. Layout: _EXT_HDR, then per location
     # device_coords(i4) arena_handle(u4) arena_offset(u8); handle 0 =
     # that location has no device copy (arena handles start at 1).
     _DEV_MARKER = 0xFFFE
-    _DEV_HDR = struct.Struct(">HI")
     _DEV_ITEM = struct.Struct(">iIQ")
     # per-segment merged-location extension (push-based merge plane,
     # shuffle/merge.py): written AFTER the device extension, BEFORE the
     # trace extension. Same impossible-host-length marker trick with
-    # 0xFFFD and the same 6-byte (marker, count) header shape, so the
-    # single peek below disambiguates all three extensions. Layout:
-    # marker(2) count(4), then per location merged_cover(u4); cover 0 =
-    # a plain per-map block. Publishes with no merged location emit
-    # zero extension bytes — legacy frames stay byte-identical.
+    # 0xFFFD. Layout: _EXT_HDR, then per location merged_cover(u4);
+    # cover 0 = a plain per-map block. Publishes with no merged
+    # location emit zero extension bytes — legacy frames stay
+    # byte-identical.
     _MRG_MARKER = 0xFFFD
-    _MRG_HDR = struct.Struct(">HI")
     _MRG_ITEM = struct.Struct(">I")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
-        ck_fixed = self._CK_HDR.size if has_ck else 0
+        ck_fixed = self._EXT_HDR.size if has_ck else 0
         ck_per_loc = self._CK_ITEM.size if has_ck else 0
         has_dev = any(loc.block.arena_handle for loc in self.locations)
-        dev_fixed = self._DEV_HDR.size if has_dev else 0
+        dev_fixed = self._EXT_HDR.size if has_dev else 0
         dev_per_loc = self._DEV_ITEM.size if has_dev else 0
         has_mrg = any(loc.block.merged_cover for loc in self.locations)
-        mrg_fixed = self._MRG_HDR.size if has_mrg else 0
+        mrg_fixed = self._EXT_HDR.size if has_mrg else 0
         mrg_per_loc = self._MRG_ITEM.size if has_mrg else 0
         budget = (
             seg_size
@@ -197,7 +196,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             for loc in group:
                 loc.write(buf)
             if has_ck and group:
-                buf.write(self._CK_HDR.pack(self._CK_MARKER, len(group)))
+                buf.write(self._EXT_HDR.pack(self._CK_MARKER, len(group)))
                 for loc in group:
                     buf.write(
                         self._CK_ITEM.pack(
@@ -206,7 +205,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
                         )
                     )
             if has_dev and group:
-                buf.write(self._DEV_HDR.pack(self._DEV_MARKER, len(group)))
+                buf.write(self._EXT_HDR.pack(self._DEV_MARKER, len(group)))
                 for loc in group:
                     buf.write(
                         self._DEV_ITEM.pack(
@@ -216,7 +215,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
                         )
                     )
             if has_mrg and group:
-                buf.write(self._MRG_HDR.pack(self._MRG_MARKER, len(group)))
+                buf.write(self._EXT_HDR.pack(self._MRG_MARKER, len(group)))
                 for loc in group:
                     buf.write(
                         self._MRG_ITEM.pack(loc.block.merged_cover & 0xFFFFFFFF)
@@ -241,9 +240,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
         # in any order
         while end - inp.tell() > cls._TRACE_EXT.size:
             pos = inp.tell()
-            peek = inp.read(cls._CK_HDR.size)
-            if len(peek) == cls._CK_HDR.size:
-                marker, count = cls._CK_HDR.unpack(peek)
+            peek = inp.read(cls._EXT_HDR.size)
+            if len(peek) == cls._EXT_HDR.size:
+                marker, count = cls._EXT_HDR.unpack(peek)
                 if marker == cls._CK_MARKER:
                     if count == len(locs):
                         for i in range(count):

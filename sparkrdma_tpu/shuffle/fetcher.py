@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import BinaryIO, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
@@ -160,7 +161,8 @@ class TpuShuffleFetcherIterator:
         self._m_merged_fallbacks = reg.counter("push.fallbacks", role=role)
 
         self._results: "queue.Queue" = queue.Queue()
-        self._lock = threading.Lock()
+        # hot: in-flight accounting and pending-queue bookkeeping only
+        self._lock = named_lock("fetcher.state", hot=True)
         # sentinel "+1": keeps has_next true until enumeration completes
         self._total_results = 1
         self._processed_results = 0
@@ -734,7 +736,7 @@ class TpuShuffleFetcherIterator:
                 return
             self._health.record_success(mid.executor_id, tenant=self._tenant)
             remaining = [len(delivery.views)]
-            lock = threading.Lock()
+            lock = named_lock("fetcher.mapped_release", allow_self_nest=True)
 
             def release_one() -> None:
                 with lock:

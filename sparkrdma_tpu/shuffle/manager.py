@@ -28,6 +28,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
+from sparkrdma_tpu.analysis.lockorder import OrderedLock, named_lock
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
@@ -76,7 +77,7 @@ class TpuShuffleManager:
         self.host = host
 
         self.node: Optional[TpuNode] = None
-        self._node_lock = threading.Lock()
+        self._node_lock = named_lock("manager.node")
 
         # driver state
         self._manager_ids: Dict[str, ShuffleManagerId] = {}
@@ -96,14 +97,17 @@ class TpuShuffleManager:
         # registry-of-shuffles structure itself and everything not
         # keyed by shuffle id. Ordering: shuffle lock OUTER, ``_lock``
         # inner (held only for dict lookups, never across handler work).
-        self._shuffle_locks: Dict[int, threading.Lock] = {}
+        self._shuffle_locks: Dict[int, OrderedLock] = {}
 
         # executor state
         self._fetch_futures: Dict[Tuple[int, int], Future] = {}
         self._fetch_acc: Dict[Tuple[int, int], List[PartitionLocation]] = {}
         self._known_managers: List[ShuffleManagerId] = []
 
-        self._lock = threading.Lock()
+        # hot: dict lookups only (see _shuffle_locks comment above) —
+        # the lock-order detector enforces that no blocking call runs
+        # under it
+        self._lock = named_lock("manager.state", hot=True)
         self._stopped = False
         # bounded map-task pool (conf map.parallelism): the engine runs
         # this executor's map tasks through here instead of a sequential
@@ -259,13 +263,15 @@ class TpuShuffleManager:
                 "rpc.handle_ms", role=self.executor_id, type=mtype
             ).observe((time.perf_counter() - t0) * 1e3)
 
-    def _shuffle_lock(self, shuffle_id: int) -> threading.Lock:
+    def _shuffle_lock(self, shuffle_id: int) -> OrderedLock:
         """Per-shuffle registry lock (driver side). Sharding by
         shuffle_id lets concurrent publishes for independent shuffles
         proceed in parallel; the global ``_lock`` is only held for the
         dict lookup (lock order: shuffle lock OUTER, ``_lock`` inner)."""
         with self._lock:
-            return self._shuffle_locks.setdefault(shuffle_id, threading.Lock())
+            return self._shuffle_locks.setdefault(
+                shuffle_id, named_lock("manager.shuffle")
+            )
 
     def _handle_hello(self, msg: ManagerHelloMsg) -> None:
         """Driver: record membership, connect back, announce to all (:121-161)."""
@@ -307,6 +313,7 @@ class TpuShuffleManager:
                 except IOError:
                     pass
 
+        # analysis: ignore[tenant-scope]: cluster-membership pre-warm, no tenant-attributed work
         threading.Thread(target=warm, name="prewarm", daemon=True).start()
 
     def _handle_fetch(self, msg: FetchPartitionLocationsMsg) -> None:

@@ -367,6 +367,7 @@ class ReduceTaskPipeline:
             done.set()
             out_q.put(_CLOSE)
 
+        # analysis: ignore[tenant-scope]: joins scoped workers and posts a sentinel, no tenant work
         threading.Thread(
             target=joiner, name="reduce-pipeline-join", daemon=True
         ).start()

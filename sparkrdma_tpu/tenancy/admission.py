@@ -18,6 +18,7 @@ import time
 from collections import deque
 from typing import Deque, Iterator, Optional
 
+from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.obs import get_registry
 
 
@@ -47,7 +48,7 @@ class AdmissionController:
     ):
         self._max = max(1, max_inflight)
         self._timeout_s = max(1, queue_timeout_ms) / 1000.0
-        self._lock = threading.Lock()
+        self._lock = named_lock("admission.state")
         self._cond = threading.Condition(self._lock)
         self._inflight = 0
         self._waiters: Deque[_Waiter] = deque()

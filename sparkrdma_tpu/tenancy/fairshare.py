@@ -30,6 +30,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Deque, Dict, Optional, Tuple
 
+from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.tenancy import current_tenant, tenant_scope
 
@@ -60,7 +61,9 @@ class FairShareExecutor:
         self._default_weight = max(1, default_weight)
         self._quantum = max(1, quantum_ms) / 1000.0
         self._pool_label = pool
-        self._lock = threading.Lock()
+        # one graph vertex per pool role; instances of different pools
+        # never nest, and the detector would flag it if they did
+        self._lock = named_lock("fairshare.state", allow_self_nest=False)
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[str, Deque[_Item]] = {}
         self._deficit: Dict[str, float] = {}

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.obs import get_registry
 
 logger = logging.getLogger(__name__)
@@ -47,7 +48,7 @@ class QuotaBroker:
         self._quota = max(0, quota_bytes)  # 0 = unlimited
         self._per_tenant = dict(per_tenant or {})
         self._block_max_s = max(1, block_max_ms) / 1000.0
-        self._lock = threading.Lock()
+        self._lock = named_lock(f"quota.{resource}")
         self._cond = threading.Condition(self._lock)
         self._usage: Dict[str, int] = {}
         reg = get_registry()
@@ -120,7 +121,7 @@ class QuotaBroker:
 
 
 # -- process-wide broker table -------------------------------------------
-_table_lock = threading.Lock()
+_table_lock = named_lock("quota.table")
 _brokers: Dict[str, QuotaBroker] = {}
 
 

@@ -794,7 +794,16 @@ class NativeTpuNode:
         if self._stopped.is_set():
             return
         self._stopped.set()
-        self._cq_thread.join(timeout=2.0)
+        # srt_node_stop frees the Node, so the poll thread must be OUT
+        # of srt_poll_cq first — the loop re-checks _stopped every
+        # 100 ms poll timeout, so this join is bounded unless a
+        # completion listener wedged
+        self._cq_thread.join(timeout=10.0)
+        if self._cq_thread.is_alive():
+            # a wedged listener: leak the native node rather than free
+            # it under the still-running poller (use-after-free)
+            logger.error("cq poll thread failed to stop; leaking native node")
+            self._np = None
         with self._lock:
             channels = list(self._channels.values())
             self._channels.clear()

@@ -40,6 +40,102 @@ class ShuffleWriterMethod(enum.Enum):
 
 PREFIX = "tpu.shuffle."
 
+# -- declared-knobs registry ----------------------------------------------
+# Every tpu.shuffle.* key the framework understands, by suffix. This is
+# the single source of truth the knob-registry analysis pass resolves
+# reads against (sparkrdma_tpu/analysis/knobs.py): a literal key that
+# is not here — in library code, tests, or benches — fails the lint, so
+# typo'd knobs die in CI instead of silently falling back to defaults.
+# Keep entries in the same order as the property getters below.
+DECLARED_KNOBS: Dict[str, str] = {
+    "recvQueueDepth": "receive queue depth (transport)",
+    "sendQueueDepth": "send queue depth (transport)",
+    "recvWrSize": "RPC segment size in bytes",
+    "cpuList": "worker thread placement list",
+    "shuffleWriteMethod": "writer strategy (wrapper|chunkedpartitionagg)",
+    "shuffleWriteChunkSize": "chunked-agg chunk size",
+    "shuffleWriteFlushSize": "wrapper writer flush size",
+    "shuffleWriteBlockSize": "writer block size",
+    "shuffleWriteMaxInMemoryStoragePerExecutor": "in-memory write budget",
+    "shuffleReadBlockSize": "reader block size",
+    "maxBytesInFlight": "reader in-flight byte cap",
+    "maxAggBlock": "aggregation block size",
+    "maxAggPrealloc": "preallocated agg buffers per executor",
+    "collectShuffleReadStats": "collect reader fetch-time stats",
+    "fetchTimeNumBuckets": "reader stats: histogram buckets",
+    "fetchTimeBucketSizeInMs": "reader stats: bucket width",
+    "obs.traceEnabled": "record spans in the per-role tracers",
+    "obs.traceMaxSpans": "retained spans per tracer",
+    "obs.telemetry.enabled": "heartbeat loops + driver TelemetryHub",
+    "obs.telemetry.intervalMs": "heartbeat period / ring bucket width",
+    "obs.telemetry.ringSize": "windows retained per executor",
+    "obs.telemetry.httpPort": "OpenMetrics scrape port (0 = off)",
+    "obs.telemetry.stragglerZ": "robust z threshold for stragglers",
+    "obs.telemetry.flightWindows": "ring windows per flight record",
+    "obs.telemetry.flightDir": "flight-record output directory",
+    "obs.telemetry.openmetricsFile": "periodic OpenMetrics file egress",
+    "driverHost": "driver RPC host",
+    "driverPort": "driver RPC port (0 = ephemeral, written back)",
+    "executorPort": "executor listener port (0 = ephemeral)",
+    "portMaxRetries": "bind retries above the base port",
+    "connectTimeoutMs": "connection establishment timeout",
+    "teardownListenTimeoutMs": "listener teardown join timeout",
+    "maxConnectionAttempts": "connect attempts per channel",
+    "partitionLocationFetchTimeoutMs": "driver location-fetch timeout",
+    "resilience.checksums": "crc32c publish/verify per block",
+    "resilience.maxFetchAttempts": "total attempts per group READ",
+    "resilience.retryBackoffMs": "retry backoff base",
+    "resilience.retryBackoffMaxMs": "retry backoff ceiling",
+    "resilience.fetchDeadlineMs": "wall budget per group (0 = none)",
+    "resilience.circuitFailureThreshold": "failures that open a breaker",
+    "resilience.circuitOpenMs": "open-circuit fail-fast window",
+    "faultPlan": "fault-injection plan spec (testing/faults.py)",
+    "faultPlanSeed": "fault-plan RNG seed",
+    "map.parallelism": "bounded map-task pool size",
+    "map.pipelineDepth": "map pipeline inter-stage queue bound",
+    "map.deviceSort": "sort + range-partition map shards on-device",
+    "map.incrementalPublish": "publish sealed writer blocks early",
+    "reduce.parallelism": "reduce decode-pool size",
+    "reduce.pipelineDepth": "reduce pipeline inter-stage queue bound",
+    "reduce.doubleBufferStaging": "overlap staging and device merge",
+    "push.enabled": "push-based merge of sealed blocks",
+    "push.maxBufferBytes": "merge-endpoint buffered push budget",
+    "publish.checksumWorkers": "publish checksum pool size (0 = inline)",
+    "planner.enabled": "adaptive reduce-partition planner",
+    "planner.hotFactor": "hot-partition isolation threshold",
+    "planner.sampleSize": "keys sampled per shard for planning",
+    "reader.sortSpillThreshold": "external-sorter in-memory record cap",
+    "transport": "host data plane: auto|python|native",
+    "fileFastPath": "native same-host READ_FILE fast path",
+    "forceSendfile": "serve file regions via sendfile to loopback",
+    "fileWorkers": "native same-host file-task workers",
+    "mappedFetch": "zero-copy mmap delivery on native transport",
+    "exchange.bucketMin": "smallest padded exchange bucket",
+    "exchange.bucketMax": "largest padded exchange bucket",
+    "hbm.slabBytes": "HBM staging slab size",
+    "hbm.maxBytes": "HBM shuffle-staging budget",
+    "hbm.hostSpillMaxBytes": "host-RAM cap for spilled slabs",
+    "hbm.spillDir": "disk-tier spill directory",
+    "deviceFetch.enabled": "HBM->HBM device fetch plane",
+    "deviceFetch.minBlockBytes": "device-plane minimum block size",
+    "tenancy.enabled": "multi-tenant serving layer",
+    "tenancy.maxConcurrentJobs": "admission in-flight job cap",
+    "tenancy.admitTimeoutMs": "admission queue deadline",
+    "tenancy.weights": "fair-share weights, e.g. alice:4,bob:1",
+    "tenancy.defaultWeight": "weight for unnamed tenants",
+    "tenancy.quantumMs": "DRR credit per round (ms per unit weight)",
+    "tenancy.mempoolQuotaBytes": "per-tenant mempool byte quota (0 = off)",
+    "tenancy.hbmQuotaBytes": "per-tenant HBM byte quota (0 = off)",
+    "tenancy.quotaBlockMaxMs": "max quota backpressure stall",
+}
+
+# Knob families with a free segment (``<seg>`` = one dot-free token),
+# e.g. per-tenant quota overrides scanned by tenancy/quota.py.
+PATTERN_KNOBS = (
+    "tenancy.quota.<seg>.mempoolBytes",
+    "tenancy.quota.<seg>.hbmBytes",
+)
+
 
 class TpuShuffleConf:
     """Dict-backed configuration with clamped typed getters.
@@ -69,6 +165,30 @@ class TpuShuffleConf:
 
     def to_dict(self) -> Dict[str, str]:
         return dict(self._conf)
+
+    def unknown_keys(self) -> list:
+        """``tpu.shuffle.*`` keys present but not declared — the
+        runtime complement of the knob-registry lint: surface typo'd
+        keys in a live conf instead of silently using defaults."""
+        import re
+
+        pats = [
+            re.compile(
+                "^" + re.escape(p).replace(re.escape("<seg>"), r"[^.]+") + "$"
+            )
+            for p in PATTERN_KNOBS
+        ]
+        out = []
+        for key in self._conf:
+            if not key.startswith(PREFIX):
+                continue
+            suffix = key[len(PREFIX):]
+            if suffix in DECLARED_KNOBS:
+                continue
+            if any(p.match(suffix) for p in pats):
+                continue
+            out.append(key)
+        return sorted(out)
 
     # -- clamped typed getters (RdmaShuffleConf.scala:47-58) --------------
     def _int(self, key: str, default: int, lo: int, hi: int) -> int:

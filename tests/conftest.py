@@ -44,3 +44,11 @@ def pytest_configure(config):
         "faults: fault-injection test (exercises the resilience retry "
         "ladder via sparkrdma_tpu.testing.faults or transport seams)",
     )
+    # race harness: SPARKRDMA_LOCK_ORDER=1 arms the lock-order detector
+    # for the whole session (sparkrdma_tpu/analysis/lockorder.py) and
+    # fails it on acquisition-order cycles or blocking calls under
+    # hot-path locks; unset, the plugin is inert
+    if not config.pluginmanager.has_plugin("sparkrdma-lockorder"):
+        from sparkrdma_tpu.analysis import pytest_plugin
+
+        config.pluginmanager.register(pytest_plugin, "sparkrdma-lockorder")
