@@ -11,6 +11,15 @@ one-sided READs — the driver never touches data.
 Closures ship via cloudpickle over a tiny task protocol
 (`engine/worker.py`); the shuffle itself rides the framework's own
 control + data planes (python or native transport per conf).
+
+Elastic behavior (docs/DESIGN.md §21): the driver survives executor
+loss. Map and reduce phases both run under a bounded recovery loop —
+when a worker process dies, the driver prunes it (``_on_peer_lost``
+promotes any replicas), re-runs exactly the *unaccounted* maps (those
+neither a surviving publish nor a promoted replica covers) on
+survivors, and re-issues the dead worker's reduce ranges. The reduce
+fan-out itself is the speculative phase from elastic/speculation.py:
+straggler-flagged attempts get cloned, first finisher wins.
 """
 
 from __future__ import annotations
@@ -174,41 +183,179 @@ class ClusterContext:
             raise
 
     def _run_map_reduce(self, handle, map_fns, num_partitions, reduce_fn, tenant):
-        # group this stage's tasks by worker and ship each group as ONE
-        # map_batch request: one socket round trip per worker instead of
-        # one per map, with the worker's bounded map pool (conf
-        # map.parallelism) running the batch concurrently
-        by_worker: Dict[int, List] = {}
-        for i, fn in enumerate(map_fns):
-            by_worker.setdefault(i % len(self.workers), []).append((i, fn))
-        # push routes for the merge plane (shuffle/merge.py): where each
-        # executor's push client reaches its peers' task servers
-        push_routes = {
-            w.executor_id: ("127.0.0.1", w.task_port) for w in self.workers
-        }
-        futures = [
-            self._pool.submit(
-                self.workers[w].request,
-                {
-                    "kind": "map_batch",
-                    "handle": handle,
-                    "tasks": tasks,
-                    "push_routes": push_routes,
-                    "tenant": tenant,
-                },
-            )
-            for w, tasks in by_worker.items()
-        ]
-        for f in futures:
-            f.result()  # raise the first map failure
-        for w in self.workers:
-            w.request({"kind": "finalize", "shuffle_id": handle.shuffle_id})
+        items = list(enumerate(map_fns))
+        self._run_map_phase(handle, items, tenant, recompute=False)
+        bounds = self._plan_bounds(handle, num_partitions)
+        return self._run_reduce_phase(handle, bounds, reduce_fn, tenant, items)
 
-        # split the partition range across workers: contiguous static
-        # bounds, re-planned from the published per-partition sizes by
-        # the adaptive partitioner when enabled (shuffle/planner.py) so
-        # a hot partition's worker is not also loaded with its neighbors
-        n = len(self.workers)
+    # -- elastic plumbing ----------------------------------------------
+    def _live_workers(self) -> List[WorkerHandle]:
+        return [w for w in self.workers if w.proc.poll() is None]
+
+    def _reap_dead(self) -> List[WorkerHandle]:
+        """Detect dead worker processes and prune them everywhere: the
+        driver's location registry (which promotes any replicas the
+        dead executor's maps left behind) and this context's dispatch
+        set. Idempotent per worker."""
+        dead = [w for w in self.workers if w.proc.poll() is not None]
+        for w in dead:
+            logger.warning(
+                "executor %s died (exit %s); pruning and promoting replicas",
+                w.executor_id, w.proc.poll(),
+            )
+            self.driver._on_peer_lost(w.executor_id)
+            self.workers.remove(w)
+        return dead
+
+    def _run_map_phase(self, handle, items, tenant, recompute: bool) -> None:
+        """Run every map task to an *accounted* publish, surviving
+        executor loss. Each round ships the still-unaccounted maps as
+        one map_batch per live worker (one socket round trip, bounded
+        worker-side concurrency) and finalizes; a round that lost
+        executors re-runs exactly ``driver.unaccounted_maps`` — maps a
+        surviving publish or a promoted replica covers are never
+        recomputed. Recovery rounds are bounded by
+        ``elastic.maxRecoveries``.
+
+        ``recompute=True`` (the reduce phase's recovery call) makes the
+        first round count as lineage recompute too; when replicas
+        already cover every map it is a no-op.
+
+        Accounting has two tiers: the wrapper writer publishes with
+        per-map lineage tags, so ``driver.map_owners`` is authoritative
+        (and replica promotion keeps covered maps owned); the
+        chunked-agg writer publishes whole-executor aggregates with no
+        per-map attribution, so for it "accounted" falls back to
+        "batch succeeded and its executor is still alive" — and
+        executor loss is only recoverable under the wrapper method
+        (re-publishing an aggregate writer's maps piecemeal could
+        double-count surviving data)."""
+        sid = handle.shuffle_id
+        fns = dict(items)
+        all_ids = [mid for mid, _ in items]
+        # batch-success accounting for writers without lineage tags
+        assigned: Dict[int, str] = {}
+
+        def unaccounted() -> List[int]:
+            owners = self.driver.map_owners(sid)
+            return [
+                mid for mid in all_ids
+                if mid not in owners and mid not in assigned
+            ]
+
+        pending = unaccounted()
+        if recompute:
+            if not pending:
+                return  # promoted replicas cover everything: zero recompute
+            self._note_recompute(len(pending))
+        recoveries = 0
+        while True:
+            if not pending:
+                return
+            workers = self._live_workers()
+            if not workers:
+                raise RuntimeError("no live executors left for map stage")
+            # push + replica routes: where each executor reaches its
+            # peers' task servers (shuffle/merge.py, elastic/)
+            routes = {
+                w.executor_id: ("127.0.0.1", w.task_port) for w in workers
+            }
+            by_worker: Dict[WorkerHandle, List] = {}
+            for j, mid in enumerate(pending):
+                by_worker.setdefault(workers[j % len(workers)], []).append(
+                    (mid, fns[mid])
+                )
+            futures = {
+                w: self._pool.submit(
+                    w.request,
+                    {
+                        "kind": "map_batch",
+                        "handle": handle,
+                        "tasks": tasks,
+                        "push_routes": routes,
+                        "tenant": tenant,
+                    },
+                )
+                for w, tasks in by_worker.items()
+            }
+            errors: List[Exception] = []
+            for w, f in futures.items():
+                try:
+                    f.result()
+                except Exception as e:
+                    errors.append(e)
+                else:
+                    for mid, _fn in by_worker[w]:
+                        assigned[mid] = w.executor_id
+            for w in workers:  # every live worker, not just batch targets
+                if w.proc.poll() is not None:
+                    continue
+                try:
+                    w.request({"kind": "finalize", "shuffle_id": sid})
+                except Exception as e:
+                    errors.append(e)
+            dead = self._reap_dead()
+            dead_ids = {w.executor_id for w in dead}
+            if dead_ids:
+                for mid, eid in list(assigned.items()):
+                    if eid in dead_ids:
+                        del assigned[mid]
+            pending = unaccounted()
+            if not pending and not errors:
+                return
+            if errors and not dead:
+                # a genuine task failure (not executor loss) is the
+                # job's failure — recompute can't fix a deterministic
+                # exception
+                raise errors[0]
+            if not dead and pending:
+                raise RuntimeError(
+                    f"maps {pending} unaccounted with all executors live"
+                )
+            if dead and not self._elastic_recovery_ok():
+                raise errors[0] if errors else RuntimeError(
+                    f"executors {sorted(dead_ids)} lost and the "
+                    "chunked-agg writer cannot recompute piecemeal"
+                )
+            if recoveries >= self.conf.elastic_max_recoveries:
+                raise errors[0] if errors else RuntimeError(
+                    f"maps {pending} still unaccounted after "
+                    f"{recoveries} recoveries"
+                )
+            recoveries += 1
+            self._note_recompute(len(pending))
+            logger.warning(
+                "map recovery %d: re-running %d unaccounted maps %s on "
+                "%d survivors", recoveries, len(pending), pending,
+                len(self._live_workers()),
+            )
+
+    def _elastic_recovery_ok(self) -> bool:
+        """Executor-loss recovery needs per-map lineage tags on the
+        published locations — only the wrapper writer provides them."""
+        from sparkrdma_tpu.utils.config import ShuffleWriterMethod
+
+        return self.conf.shuffle_writer_method == ShuffleWriterMethod.WRAPPER
+
+    def _note_recompute(self, num_maps: int) -> None:
+        from sparkrdma_tpu.obs import get_registry
+
+        reg = get_registry()
+        reg.counter("engine.stage_recomputes").inc()
+        reg.counter(
+            "elastic.recoveries", role=self.driver.executor_id
+        ).inc()
+        reg.counter(
+            "elastic.recomputed_maps", role=self.driver.executor_id
+        ).inc(num_maps)
+
+    def _plan_bounds(self, handle, num_partitions) -> List:
+        """Split the partition range across live workers: contiguous
+        static bounds, re-planned from the published per-partition
+        sizes by the adaptive partitioner when enabled
+        (shuffle/planner.py) so a hot partition's worker is not also
+        loaded with its neighbors."""
+        n = len(self._live_workers())
         bounds = [
             (w * num_partitions // n, (w + 1) * num_partitions // n)
             for w in range(n)
@@ -224,22 +371,56 @@ class ClusterContext:
                 bounds = ranges + [
                     (num_partitions, num_partitions)
                 ] * (n - len(ranges))
-        futures = [
-            self._pool.submit(
-                self.workers[w].request,
-                {
-                    "kind": "reduce",
-                    "handle": handle,
-                    "start": lo,
-                    "end": hi,
-                    "reduce_fn": reduce_fn,
-                    "tenant": tenant,
-                },
-            )
-            for w, (lo, hi) in enumerate(bounds)
-            if hi > lo
+        return bounds
+
+    def _run_reduce_phase(self, handle, bounds, reduce_fn, tenant, items):
+        """Reduce fan-out with speculation and executor-loss recovery.
+
+        Ranges are fixed up front (results must align regardless of
+        later deaths); each round runs the outstanding ranges through a
+        :class:`SpeculativeReducePhase`. Ranges whose every attempt
+        failed trigger recovery when the failure was an executor death:
+        prune + promote, re-run unaccounted maps, then re-issue just
+        the failed ranges on survivors."""
+        from sparkrdma_tpu.elastic.speculation import SpeculativeReducePhase
+
+        workers = self._live_workers()
+        assignments = [
+            (i, rng, workers[i]) for i, rng in enumerate(bounds) if rng[1] > rng[0]
         ]
-        return [f.result() for f in futures]
+        rng_by_idx = {idx: rng for idx, rng, _ in assignments}
+        results: Dict[int, object] = {}
+        todo = assignments
+        recoveries = 0
+        while todo:
+            phase = SpeculativeReducePhase(
+                self.driver, self._pool, self.conf, self._live_workers,
+                handle, reduce_fn, tenant,
+            )
+            done, failed = phase.run(todo)
+            results.update(done)
+            if not failed:
+                break
+            dead = self._reap_dead()
+            if (
+                not dead
+                or not self._elastic_recovery_ok()
+                or recoveries >= self.conf.elastic_max_recoveries
+            ):
+                raise next(iter(failed.values()))
+            recoveries += 1
+            # re-run the maps the dead executors took with them, then
+            # re-issue only the failed ranges on survivors (fresh
+            # locations resolve on fetch)
+            self._run_map_phase(handle, items, tenant, recompute=True)
+            survivors = self._live_workers()
+            if not survivors:
+                raise RuntimeError("no live executors left for reduce stage")
+            todo = [
+                (idx, rng_by_idx[idx], survivors[k % len(survivors)])
+                for k, idx in enumerate(sorted(failed))
+            ]
+        return [results[idx] for idx, _rng, _w in assignments]
 
     # ------------------------------------------------------------------
     def stop(self) -> None:

@@ -97,6 +97,30 @@ class TpuContext:
     def executor_for_partition(self, partition: int) -> TpuShuffleManager:
         return self.executors[partition % len(self.executors)]
 
+    def lose_executor(self, executor_id: str) -> None:
+        """Simulate executor death in the in-process topology
+        (DESIGN.md §21): drop the executor from the partition router,
+        run the driver's peer-loss path — prune, replica promotion,
+        barrier re-arm — then release the lost manager's resources
+        (a dead process never unpublishes, so the teardown happens
+        only AFTER the prune, and quietly).
+
+        With replica coverage (`tpu.shuffle.elastic.replicas` > 0)
+        later reads complete against the promoted holders with zero
+        recompute; without it they defer into
+        MetadataFetchFailedError and ``run_job``'s stage-recompute
+        attempt re-runs the lost maps on the survivors."""
+        lost = next(
+            (m for m in self.executors if m.executor_id == executor_id), None
+        )
+        if lost is None:
+            raise KeyError(f"unknown executor {executor_id!r}")
+        if len(self.executors) == 1:
+            raise ValueError("cannot lose the last executor")
+        self.executors = [m for m in self.executors if m is not lost]
+        self.driver._on_peer_lost(lost.executor_id)
+        lost.stop()
+
     # ------------------------------------------------------------------
     def parallelize(self, data, num_partitions: int = None) -> RDD:
         n = num_partitions or len(self.executors)

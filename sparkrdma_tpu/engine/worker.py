@@ -71,6 +71,10 @@ class Worker:
         self.manager = TpuShuffleManager(conf, is_driver=False, executor_id=executor_id)
         self.manager.start_node_if_missing()  # hello to driver now
         self._stop = threading.Event()
+        # in-flight reduce readers keyed (shuffle_id, start, end) so a
+        # cancel_reduce request can fire the pipeline's abort latch
+        self._reduces: dict = {}
+        self._reduce_lock = threading.Lock()
         # outbox-mode heartbeater: samples role-filtered registry deltas
         # on a timer; the driver pulls them with {"kind": "telemetry"}
         self.heartbeater = None
@@ -86,6 +90,7 @@ class Worker:
         t0 = time.perf_counter()
         plan = _faults.active()
         if plan is not None:
+            plan.on_exec(self.manager.executor_id, stage="map_task")
             plan.on_stage("map_task", [], peer=self.manager.executor_id)
         try:
             writer = self.manager.get_writer(handle, map_id)
@@ -123,6 +128,9 @@ class Worker:
                 # {executor_id: (host, task_port)}: where this worker's
                 # push client ships sealed blocks (shuffle/merge.py)
                 self.manager.push_client.set_routes(routes)
+            if routes and self.manager.replica_client is not None:
+                # the replication plane rides the same routes (elastic/)
+                self.manager.replica_client.set_routes(routes)
             # the submit captures the tenant scope, so the fair-share
             # pool queues this batch under the requesting tenant
             with tenancy.tenant_scope(req.get("tenant")):
@@ -159,14 +167,21 @@ class Worker:
             t0 = time.perf_counter()
             plan = _faults.active()
             if plan is not None:
+                plan.on_exec(self.manager.executor_id, stage="reduce_task")
                 plan.on_stage("reduce_task", [], peer=self.manager.executor_id)
+            rkey = (handle.shuffle_id, req["start"], req["end"])
             with tenancy.tenant_scope(req.get("tenant")):
                 reader = self.manager.get_reader(handle, req["start"], req["end"])
+                with self._reduce_lock:
+                    self._reduces[rkey] = reader
                 try:
                     it = reader.read()
                     fn = req.get("reduce_fn")
                     result = fn(it) if fn is not None else list(it)
                 finally:
+                    with self._reduce_lock:
+                        if self._reduces.get(rkey) is reader:
+                            del self._reduces[rkey]
                     # task-completion sweep: a reduce_fn that bails without
                     # consuming must not strand fetched streams until GC
                     reader.close()
@@ -175,6 +190,53 @@ class Worker:
                         kind="reduce", tenant=tenancy.current_tenant(),
                     ).observe((time.perf_counter() - t0) * 1000.0)
             return {"ok": True, "result": result}
+        if kind == "cancel_reduce":
+            # speculation loser drain (elastic/speculation.py): closing
+            # the in-flight reader fires the reduce pipeline's abort
+            # latch; the losing task thread unwinds instead of finishing
+            rkey = (req["shuffle_id"], req["start"], req["end"])
+            with self._reduce_lock:
+                reader = self._reduces.pop(rkey, None)
+            if reader is not None:
+                try:
+                    reader.close()
+                except Exception:
+                    pass
+            return {"ok": True, "result": reader is not None}
+        if kind == "replicate_blocks":
+            # elastic replication ingest (elastic/replication.py): the
+            # reply is sent only after the replica locations published,
+            # so the source's map task never outruns its durability
+            store = self.manager.replica_store
+            accepted = 0
+            if store is not None:
+                accepted = store.accept(
+                    req["shuffle_id"],
+                    req["source"],
+                    req["map_id"],
+                    req.get("blocks") or [],
+                )
+            return {"ok": True, "result": accepted}
+        if kind == "handoff":
+            # shuffle-service handoff (elastic/service.py): describe all
+            # committed map outputs by file path + partition lengths and
+            # ask the daemon to adopt them — no byte copy; the daemon
+            # hard-links and re-mmaps the same inodes
+            from sparkrdma_tpu.elastic.service import send_adopt
+
+            host, port = req["service"]
+            manifests = {}
+            for sid in self.manager.resolver.shuffle_ids():
+                data = self.manager.resolver.get_shuffle_data(sid)
+                manifest = getattr(data, "handoff_manifest", None)
+                if manifest is not None:
+                    maps = manifest()
+                    if maps:
+                        manifests[sid] = maps
+            adopted = send_adopt(
+                (host, port), self.manager.executor_id, manifests
+            )
+            return {"ok": True, "result": adopted}
         if kind == "telemetry":
             # control-plane pull: hand buffered heartbeats to the driver
             payloads = (

@@ -53,6 +53,16 @@ class BlockLocation:
     partition's originals only when ``merged_cover`` equals their
     count, and the originals always remain the durable fallback. Rides
     a trailing frame extension (rpc.py), never the legacy 16-byte form.
+
+    ``replica_of``/``source_map`` are the elastic layer's lineage tag
+    (sparkrdma_tpu/elastic/): ``source_map`` names the map task that
+    produced the bytes (-1 = unattributed, e.g. chunked-agg finalize
+    segments), ``replica_of`` names the executor whose primary copy
+    these bytes duplicate ("" = a primary). Replica locations never
+    enter fetch replies directly — the driver diverts them into its
+    replica registry and promotes them only when the primary's
+    executor is lost. Both ride a trailing frame extension (rpc.py),
+    never the legacy 16-byte form.
     """
 
     address: int
@@ -64,6 +74,8 @@ class BlockLocation:
     arena_handle: int = 0
     arena_offset: int = 0
     merged_cover: int = 0
+    replica_of: str = ""
+    source_map: int = -1
 
     SERIALIZED_SIZE = _BLOCK.size
 
@@ -76,6 +88,11 @@ class BlockLocation:
     def is_merged(self) -> bool:
         """True when this is a merged segment (covers >= 1 originals)."""
         return self.merged_cover != 0
+
+    @property
+    def is_replica(self) -> bool:
+        """True when this duplicates another executor's primary copy."""
+        return bool(self.replica_of)
 
     def write(self, out: BinaryIO) -> None:
         out.write(_BLOCK.pack(self.address, self.length, self.mkey))

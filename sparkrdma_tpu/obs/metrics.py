@@ -57,6 +57,20 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "device_fetch.plane.fallbacks": ("counter", _L({"role"})),
     "device_fetch.plane.pulls": ("counter", _L({"role"})),
     "device_fetch.plane.plan_ms": ("histogram", _L({"role"})),
+    # elastic cluster: replication, speculation, service (elastic/)
+    "elastic.publishes_dropped": ("counter", _L({"role"})),
+    "elastic.replica_promotions": ("counter", _L({"role"})),
+    "elastic.replica_accepts": ("counter", _L({"role"})),
+    "elastic.replica_drops": ("counter", _L({"role"})),
+    "elastic.replicated_maps": ("counter", _L({"role"})),
+    "elastic.replicated_bytes": ("counter", _L({"role"})),
+    "elastic.replica_errors": ("counter", _L({"role"})),
+    "elastic.speculations": ("counter", _L({"role"})),
+    "elastic.speculation_wins": ("counter", _L({"role"})),
+    "elastic.clone_cancels": ("counter", _L({"role"})),
+    "elastic.recoveries": ("counter", _L({"role"})),
+    "elastic.recomputed_maps": ("counter", _L({"role"})),
+    "elastic.handoff_maps": ("counter", _L({"role"})),
     # engine (engine/)
     "engine.stage_recomputes": ("counter", _L()),
     "engine.task_ms": ("histogram", _L({"kind", "role", "tenant"})),

@@ -471,6 +471,10 @@ class TelemetryHub:
             for eid in rollups
         }
         stragglers: set = set()
+        # (tenant, eid) pairs behind each flag: the tenant label
+        # survives the role/executor strip, so the verdicts stay
+        # tenant-scoped all the way into the health registry
+        flagged_pairs: set = set()
         for stage, per_exec in busy_by_stage.items():
             for eid, v in per_exec.items():
                 details[eid]["busy_ms"] += v
@@ -483,6 +487,9 @@ class TelemetryHub:
                 z = _robust_z(v, values)
                 if z > self.straggler_z and (v - med) > MIN_BUSY_EXCESS_MS:
                     stragglers.add(eid)
+                    flagged_pairs.add(
+                        (parse_metric_key(stage)[1].get("tenant", ""), eid)
+                    )
                     details[eid]["flags"].append({
                         "kind": "busy", "stage": stage,
                         "z": round(z, 2), "value_ms": round(v, 3),
@@ -502,16 +509,30 @@ class TelemetryHub:
                 z = _robust_z(v, values)
                 if z < -self.straggler_z and v < med / 2:
                     stragglers.add(eid)
+                    flagged_pairs.add(
+                        (parse_metric_key(family)[1].get("tenant", ""), eid)
+                    )
                     details[eid]["flags"].append({
                         "kind": "work", "family": family,
                         "z": round(z, 2), "value_bytes": int(v),
                         "median_bytes": int(med),
                     })
+        # breaker-registry-shaped suspect keys: bare executor id for
+        # the default tenant, "<tenant>:<executor>" otherwise — the
+        # exact format SourceHealthRegistry._key produces, so
+        # apply_straggler_report needs no re-derivation
+        from sparkrdma_tpu.tenancy import DEFAULT_TENANT
+
+        suspect_keys = sorted(
+            eid if (not t or t == DEFAULT_TENANT) else f"{t}:{eid}"
+            for t, eid in flagged_pairs
+        )
         report = {
             "generated_wall_ms": int(self._clock() * 1000),
             "threshold_z": self.straggler_z,
             "executors": details,
             "stragglers": sorted(stragglers),
+            "suspect_keys": suspect_keys,
         }
         return report
 

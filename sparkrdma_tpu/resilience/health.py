@@ -196,8 +196,17 @@ class SourceHealthRegistry:
         ``resilience.straggler_advisories``) but never opens its
         circuit — only the breaker's own consecutive fetch failures do
         that. Suspects that fall out of the report are cleared.
+
+        Suspect keys match :meth:`_key` — bare executor id for the
+        default tenant, ``<tenant>:<executor>`` otherwise — so a
+        straggler verdict derived from one tenant's task metrics never
+        smears that executor for other tenants. Reports from older
+        hubs without ``suspect_keys`` fall back to the tenant-blind
+        ``stragglers`` list.
         """
-        flagged = set(report.get("stragglers") or ())
+        flagged = set(
+            report.get("suspect_keys") or report.get("stragglers") or ()
+        )
         wall_ms = report.get("generated_wall_ms", 0)
         with self._lock:
             new = flagged - set(self._suspects)

@@ -146,6 +146,16 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # byte-identical.
     _MRG_MARKER = 0xFFFD
     _MRG_ITEM = struct.Struct(">I")
+    # per-segment elastic lineage extension (sparkrdma_tpu/elastic/):
+    # written AFTER the merged extension, BEFORE the trace extension.
+    # Same impossible-host-length marker trick with 0xFFFC. Layout:
+    # _EXT_HDR, then per location source_map(i4) replica_len(u2)
+    # followed by replica_len utf-8 bytes naming the executor whose
+    # primary copy the block duplicates (0 bytes = a primary block,
+    # source_map -1 = unattributed). Publishes with no lineage tag emit
+    # zero extension bytes — legacy frames stay byte-identical.
+    _ELA_MARKER = 0xFFFC
+    _ELA_ITEM = struct.Struct(">iH")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
@@ -157,6 +167,11 @@ class PublishPartitionLocationsMsg(RpcMsg):
         has_mrg = any(loc.block.merged_cover for loc in self.locations)
         mrg_fixed = self._EXT_HDR.size if has_mrg else 0
         mrg_per_loc = self._MRG_ITEM.size if has_mrg else 0
+        has_ela = any(
+            loc.block.replica_of or loc.block.source_map >= 0
+            for loc in self.locations
+        )
+        ela_fixed = self._EXT_HDR.size if has_ela else 0
         budget = (
             seg_size
             - SEG_HEADER.size
@@ -165,6 +180,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             - ck_fixed
             - dev_fixed
             - mrg_fixed
+            - ela_fixed
         )
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
@@ -172,6 +188,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
         used = 0
         for loc in self.locations:
             sz = loc.serialized_size() + ck_per_loc + dev_per_loc + mrg_per_loc
+            if has_ela:
+                # variable per-loc cost: fixed item + the replica id bytes
+                sz += self._ELA_ITEM.size + len(loc.block.replica_of.encode())
             if sz > budget:
                 raise ValueError(
                     f"partition location ({sz} bytes) exceeds segment budget {budget}"
@@ -220,6 +239,12 @@ class PublishPartitionLocationsMsg(RpcMsg):
                     buf.write(
                         self._MRG_ITEM.pack(loc.block.merged_cover & 0xFFFFFFFF)
                     )
+            if has_ela and group:
+                buf.write(self._EXT_HDR.pack(self._ELA_MARKER, len(group)))
+                for loc in group:
+                    rep = loc.block.replica_of.encode("utf-8")
+                    buf.write(self._ELA_ITEM.pack(loc.block.source_map, len(rep)))
+                    buf.write(rep)
             buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
@@ -296,6 +321,27 @@ class PublishPartitionLocationsMsg(RpcMsg):
                                 )
                     else:
                         inp.read(count * cls._MRG_ITEM.size)
+                    continue
+                if marker == cls._ELA_MARKER:
+                    # items are variable width (fixed header + replica id
+                    # bytes), so even the count-mismatch skip must walk
+                    # them item by item
+                    for i in range(count):
+                        source_map, rep_len = cls._ELA_ITEM.unpack(
+                            inp.read(cls._ELA_ITEM.size)
+                        )
+                        rep = inp.read(rep_len).decode("utf-8")
+                        if count != len(locs):
+                            continue  # corrupt/foreign ext: discard
+                        if rep or source_map >= 0:
+                            locs[i] = replace(
+                                locs[i],
+                                block=replace(
+                                    locs[i].block,
+                                    replica_of=rep,
+                                    source_map=source_map,
+                                ),
+                            )
                     continue
             inp.seek(pos)
             locs.append(PartitionLocation.read(inp))

@@ -43,6 +43,7 @@ from typing import BinaryIO, Dict, List, Optional, Tuple
 
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.analysis.lockorder import named_lock
+from sparkrdma_tpu.tenancy import quota as _tquota
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.memory.registered_buffer import RegisteredBuffer
 from sparkrdma_tpu.memory.streams import MemoryviewInputStream
@@ -247,6 +248,13 @@ class TpuShuffleFetcherIterator:
         # tasks that finish after the reader starts and silently drop
         # their records. The reply is complete by construction, so the
         # resolver now holds every local block the reply names.
+        #
+        # Replica blocks this executor HOLDS (promoted by the driver
+        # after their source died) are excluded from the pid set: the
+        # resolver's local streams cover only this executor's own
+        # committed map outputs, while replica bytes live in the
+        # ReplicaStore's registered segment — they are served by direct
+        # resolve below, never by the stream short-circuit.
         my_id = self._manager.executor_id
         resolver = self._manager.resolver
         local_pids = sorted(
@@ -254,6 +262,7 @@ class TpuShuffleFetcherIterator:
                 loc.partition_id
                 for loc in locations
                 if loc.manager_id.executor_id == my_id
+                and not loc.block.replica_of
             }
         )
         local_streams: List[Tuple[int, BinaryIO]] = []
@@ -269,7 +278,21 @@ class TpuShuffleFetcherIterator:
             loc.block.length
             for loc in locations
             if loc.manager_id.executor_id == my_id
+            and not loc.block.replica_of
         )
+        unreadable_replicas: List[PartitionLocation] = []
+        for loc in locations:
+            if loc.manager_id.executor_id != my_id or not loc.block.replica_of:
+                continue
+            streams = self._read_local_replica(loc)
+            if streams is None:
+                # segment gone (store teardown race): let the remote
+                # ladder re-resolve and fail over to another holder
+                unreadable_replicas.append(loc)
+                continue
+            local_streams.extend(streams)
+            local_bytes += loc.block.length
+            self.metrics.local_blocks += 1
         self.metrics.local_bytes += local_bytes
         self._m_local_blocks.inc(len(local_streams))
         self._m_local_bytes.inc(local_bytes)
@@ -283,7 +306,8 @@ class TpuShuffleFetcherIterator:
         by_manager: Dict[ShuffleManagerId, List[Tuple[int, BlockLocation]]] = {}
         for loc in locations:
             if loc.manager_id.executor_id == my_id:
-                continue  # served locally above
+                if loc not in unreadable_replicas:
+                    continue  # served locally above
             by_manager.setdefault(loc.manager_id, []).append((loc.partition_id, loc.block))
 
         # pack per-manager groups ≤ read_block_size (:252-275)
@@ -428,9 +452,14 @@ class TpuShuffleFetcherIterator:
         replaces the stale handle, and the fresh ShuffleManagerId
         carries the respawned endpoint's host:port. Blocks never
         migrate across executor identities without a stage recompute,
-        so matching stays within ``mid.executor_id`` — a cross-manager
-        "match" would be a different map output's data. Runs on a retry
-        timer thread, so blocking on the location future is fine."""
+        so matching stays within ``mid.executor_id`` — the one sanctioned
+        exception is a location whose ``replica_of`` IS that identity
+        (elastic replication / service handoff): that block is a
+        byte-identical copy of the same map output published under a
+        surviving holder, so failing over to it is still an
+        identity-preserving retarget. Primaries outrank replicas when
+        both are live. Runs on a retry timer thread, so blocking on the
+        location future is fine."""
         mid, group = fetch.manager_id, fetch.group
         try:
             future = self._manager.fetch_remote_partition_locations(
@@ -447,9 +476,14 @@ class TpuShuffleFetcherIterator:
             return
         self._m_failovers.inc()
         pool: Dict[Tuple[int, int], List[PartitionLocation]] = {}
+        replicas: List[PartitionLocation] = []
         for loc in fresh:
             if loc.manager_id.executor_id != mid.executor_id:
+                if loc.block.replica_of == mid.executor_id:
+                    replicas.append(loc)
                 continue
+            pool.setdefault((loc.partition_id, loc.block.length), []).append(loc)
+        for loc in replicas:  # appended after ALL primaries: lower rank
             pool.setdefault((loc.partition_id, loc.block.length), []).append(loc)
         new_mid = mid
         new_blocks: List[Tuple[int, BlockLocation]] = []
@@ -526,6 +560,35 @@ class TpuShuffleFetcherIterator:
             logger.warning(
                 "local merged segment for pid %d unusable (%s); "
                 "falling back to originals",
+                loc.partition_id,
+                e,
+            )
+            return None
+        return [(loc.partition_id, MemoryviewInputStream(view))]
+
+    def _read_local_replica(self, loc: PartitionLocation):
+        """Serve a promoted replica block held by THIS executor. Its
+        bytes sit in the local ReplicaStore's registered segment, which
+        the resolver's local-stream path (own map outputs only) cannot
+        see — resolve the registered memory directly, with the same
+        local checksum gate as ``_read_local_merged``. Returns the
+        (pid, stream) list, or None to route through the remote ladder."""
+        block = loc.block
+        try:
+            view = self._manager.node.pd.resolve(
+                block.mkey, block.address, block.length
+            )
+            if not _checksum.verify(view, block.checksum, block.checksum_algo):
+                raise ChecksumError(
+                    self._handle.shuffle_id,
+                    loc.partition_id,
+                    f"replica block of {block.length} bytes (local)",
+                )
+        except Exception as e:
+            self._m_checksum_failures.inc()
+            logger.warning(
+                "local replica block for pid %d unusable (%s); "
+                "routing through remote refetch",
                 loc.partition_id,
                 e,
             )
@@ -716,9 +779,24 @@ class TpuShuffleFetcherIterator:
         from page-cache mappings, remote ones from one malloc'd blob.
         The delivery releases when the LAST of its block streams
         closes, exactly like the registered buffer's refcounted
-        slices (:399-429)."""
+        slices (:399-429).
+
+        Mapped bytes never touch the mempool, so the tenant's quota
+        ledger would be blind to them: the group's length is charged
+        against the ``pagecache`` broker for exactly the life of the
+        delivery (released once — on failure cleanup or when the last
+        stream closes)."""
         mid, group = fetch.manager_id, fetch.group
-        fail = self._group_failure(fetch)
+        broker = _tquota.broker("pagecache")
+        if broker is not None:
+            broker.charge(self._tenant, group.total_length)
+        charge_once = threading.Lock()
+
+        def release_charge() -> None:
+            if broker is not None and charge_once.acquire(blocking=False):
+                broker.release(self._tenant, group.total_length)
+
+        fail = self._group_failure(fetch, cleanup=release_charge)
 
         def on_success(delivery) -> None:
             bad = self._bad_block(group, delivery.views)
@@ -744,6 +822,7 @@ class TpuShuffleFetcherIterator:
                     last = remaining[0] == 0
                 if last:
                     delivery.release()
+                    release_charge()
 
             streams: List[Tuple[int, BinaryIO]] = [
                 (pid, MemoryviewInputStream(view, on_close=release_one))

@@ -89,6 +89,15 @@ class TpuShuffleManager:
         # per-executor attribution of published map outputs, so peer loss
         # can re-arm the barrier (shuffle_id -> executor_id -> count)
         self._maps_by_exec: Dict[int, Dict[str, int]] = {}
+        # elastic layer (sparkrdma_tpu/elastic/): first-finisher map
+        # ownership (shuffle_id -> map_id -> executor_id; a later
+        # publish of an owned map — a speculative clone losing the race
+        # — is dropped whole) and the replica registry (shuffle_id ->
+        # partition_id -> replica locations). Replicas never enter
+        # fetch replies; _on_peer_lost promotes them when their primary
+        # executor dies.
+        self._map_owner: Dict[int, Dict[int, str]] = {}
+        self._replica_locations: Dict[int, Dict[int, List[PartitionLocation]]] = {}
         # publish/fetch mutation of ONE shuffle's registry serializes on
         # that shuffle's lock, not the manager-wide ``_lock`` — under a
         # contended map pool, concurrent shuffles' publishes used to
@@ -194,6 +203,18 @@ class TpuShuffleManager:
             self.push_client = _merge.PushClient(self)
             self.merge_endpoint = _merge.MergeEndpoint(self)
             _merge.register_endpoint(self.merge_endpoint)
+        # elastic replication plane (sparkrdma_tpu/elastic/): executors
+        # host a replica store (receiving peers' map-output copies) and
+        # a replica client (shipping their own) when durability is on.
+        # Like push/merge, a best-effort overlay on the locations API.
+        self.replica_client = None
+        self.replica_store = None
+        if conf.elastic_replicas > 0 and not is_driver:
+            from sparkrdma_tpu import elastic as _elastic
+
+            self.replica_client = _elastic.ReplicaClient(self)
+            self.replica_store = _elastic.ReplicaStore(self)
+            _elastic.register_store(self.replica_store)
         # publish-time checksum tagging pool (lazy; see _checksummed)
         self._ck_pool: Optional[ThreadPoolExecutor] = None
 
@@ -375,6 +396,18 @@ class TpuShuffleManager:
                     locations=len(msg.locations),
                     map_outputs=msg.num_map_outputs,
                 )
+            # replica publishes (elastic layer) divert whole into the
+            # replica registry: they must never reach fetch replies or
+            # the planner's byte totals until a promotion makes them
+            # primary (_on_peer_lost)
+            if msg.locations and msg.locations[0].block.is_replica:
+                with self._shuffle_lock(msg.shuffle_id):
+                    with self._lock:
+                        reg = self._replica_locations.setdefault(msg.shuffle_id, {})
+                    for loc in msg.locations:
+                        if loc.block.is_replica:
+                            reg.setdefault(loc.partition_id, []).append(loc)
+                return
             # writers publish with partition_id = -1; re-key every location
             # by its own partition id (:68-95)
             to_reply: List[FetchPartitionLocationsMsg] = []
@@ -382,6 +415,25 @@ class TpuShuffleManager:
                 with self._lock:
                     shuffle = self._partition_locations.setdefault(msg.shuffle_id, {})
                     handle = self._registered.get(msg.shuffle_id)
+                # first-finisher-wins dedup for attributed map publishes:
+                # a speculative clone of a map whose original already
+                # published (or vice versa) is dropped whole, so the
+                # barrier and the location registry never double-count
+                owner_map = self._map_owner.setdefault(msg.shuffle_id, {})
+                if (
+                    msg.num_map_outputs > 0
+                    and msg.locations
+                    and msg.locations[0].block.source_map >= 0
+                ):
+                    map_id = msg.locations[0].block.source_map
+                    exec_id = msg.locations[0].manager_id.executor_id
+                    prev = owner_map.get(map_id)
+                    if prev is not None and prev != exec_id:
+                        self.registry.counter(
+                            "elastic.publishes_dropped", role=self.executor_id
+                        ).inc()
+                        return
+                    owner_map[map_id] = exec_id
                 for loc in msg.locations:
                     shuffle.setdefault(loc.partition_id, []).append(loc)
                 if msg.is_last and msg.num_map_outputs > 0:
@@ -428,17 +480,30 @@ class TpuShuffleManager:
         completeness barrier, so later fetches defer (and eventually
         time out into MetadataFetchFailedError on the reducer) instead
         of receiving a complete-looking but incomplete location set —
-        the reference's missing-MapStatus semantics."""
+        the reference's missing-MapStatus semantics.
+
+        Elastic layer: before re-arming the barrier, any replica of the
+        lost executor's blocks (elastic/replication.py, the service
+        daemon) is *promoted* into the primary registry — the barrier
+        only drops by the maps no replica covers, so a fully replicated
+        executor's death costs zero recompute."""
         if not self.is_driver:
             return
         with self._lock:
             self._manager_ids.pop(executor_id, None)
-            shuffle_ids = set(self._partition_locations) | set(self._maps_by_exec)
+            shuffle_ids = (
+                set(self._partition_locations)
+                | set(self._maps_by_exec)
+                | set(self._replica_locations)
+            )
         for shuffle_id in shuffle_ids:
+            promoted_maps: set = set()
             with self._shuffle_lock(shuffle_id):
                 with self._lock:
                     shuffle = self._partition_locations.get(shuffle_id)
                     by_exec = self._maps_by_exec.get(shuffle_id)
+                    replicas = self._replica_locations.get(shuffle_id)
+                    owner_map = self._map_owner.get(shuffle_id)
                 if shuffle is not None:
                     for pid in list(shuffle.keys()):
                         shuffle[pid] = [
@@ -446,12 +511,68 @@ class TpuShuffleManager:
                             for loc in shuffle[pid]
                             if loc.manager_id.executor_id != executor_id
                         ]
+                if replicas is not None:
+                    # drop replicas the lost executor itself was holding,
+                    # then promote its surviving replicas into the
+                    # primary registry (replica_of stays set so the
+                    # fetchers' failover rung can identity-match them)
+                    promoted_by_holder: Dict[str, set] = {}
+                    for pid in list(replicas.keys()):
+                        keep: List[PartitionLocation] = []
+                        for loc in replicas[pid]:
+                            if loc.manager_id.executor_id == executor_id:
+                                continue
+                            if loc.block.replica_of == executor_id:
+                                if shuffle is None:
+                                    with self._lock:
+                                        shuffle = self._partition_locations.setdefault(
+                                            shuffle_id, {}
+                                        )
+                                shuffle.setdefault(loc.partition_id, []).append(loc)
+                                if loc.block.source_map >= 0:
+                                    promoted_maps.add(loc.block.source_map)
+                                    promoted_by_holder.setdefault(
+                                        loc.manager_id.executor_id, set()
+                                    ).add(loc.block.source_map)
+                            else:
+                                keep.append(loc)
+                        replicas[pid] = keep
+                    # re-attribute the covered maps to their new holders
+                    # so a later loss of the holder re-arms the barrier
+                    if promoted_maps and by_exec is not None:
+                        for holder, maps in promoted_by_holder.items():
+                            owned = {
+                                m for m in maps
+                                if owner_map is None
+                                or owner_map.get(m) == executor_id
+                            }
+                            if not owned:
+                                continue
+                            by_exec[holder] = by_exec.get(holder, 0) + len(owned)
+                            if owner_map is not None:
+                                for m in owned:
+                                    owner_map[m] = holder
+                if owner_map is not None:
+                    # uncovered maps lose their owner: the recompute's
+                    # re-publish must be accepted, not deduped away
+                    for m in [
+                        m for m, e in owner_map.items()
+                        if e == executor_id and m not in promoted_maps
+                    ]:
+                        del owner_map[m]
                 if by_exec is not None:
                     lost = by_exec.pop(executor_id, 0)
                     if lost:
+                        covered = min(len(promoted_maps), lost)
                         self._maps_done[shuffle_id] = (
-                            self._maps_done.get(shuffle_id, 0) - lost
+                            self._maps_done.get(shuffle_id, 0)
+                            - lost
+                            + covered
                         )
+            if promoted_maps:
+                self.registry.counter(
+                    "elastic.replica_promotions", role=self.executor_id
+                ).inc(len(promoted_maps))
         logger.info("pruned locations of lost executor %s", executor_id)
 
     # ------------------------------------------------------------------
@@ -699,6 +820,22 @@ class TpuShuffleManager:
             ids.add(self.executor_id)
         return sorted(ids)
 
+    def map_owners(self, shuffle_id: int) -> Dict[int, str]:
+        """Driver: snapshot of first-finisher map ownership (elastic
+        layer): map_id -> executor_id of the publish that won. Maps
+        whose owner died uncovered are absent — exactly the set a
+        partial stage recompute must re-run."""
+        with self._shuffle_lock(shuffle_id):
+            with self._lock:
+                return dict(self._map_owner.get(shuffle_id, {}))
+
+    def unaccounted_maps(self, shuffle_id: int, map_ids) -> List[int]:
+        """Driver: the subset of ``map_ids`` with no surviving owner —
+        neither the original publish nor a promoted replica covers
+        them, so lineage recompute must re-run them."""
+        owners = self.map_owners(shuffle_id)
+        return sorted(m for m in map_ids if m not in owners)
+
     def partition_sizes(self, shuffle_id: int) -> Dict[int, int]:
         """Driver: published per-partition byte totals (original
         locations only — merged segments re-cover the same bytes). The
@@ -724,6 +861,8 @@ class TpuShuffleManager:
     def unregister_shuffle(self, shuffle_id: int) -> None:
         if self.merge_endpoint is not None:
             self.merge_endpoint.drop_shuffle(shuffle_id)
+        if self.replica_store is not None:
+            self.replica_store.drop_shuffle(shuffle_id)
         if self.telemetry is not None:
             self.telemetry.drop_partition_bytes(shuffle_id)
         self.resolver.remove_shuffle(shuffle_id)
@@ -733,6 +872,8 @@ class TpuShuffleManager:
             self._maps_done.pop(shuffle_id, None)
             self._deferred_fetches.pop(shuffle_id, None)
             self._maps_by_exec.pop(shuffle_id, None)
+            self._map_owner.pop(shuffle_id, None)
+            self._replica_locations.pop(shuffle_id, None)
             self._shuffle_locks.pop(shuffle_id, None)
 
     # ------------------------------------------------------------------
@@ -815,6 +956,11 @@ class TpuShuffleManager:
 
             _merge.unregister_endpoint(self.merge_endpoint)
             self.merge_endpoint.stop()
+        if self.replica_store is not None:
+            from sparkrdma_tpu import elastic as _elastic
+
+            _elastic.unregister_store(self.replica_store)
+            self.replica_store.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         if self.reader_stats is not None:

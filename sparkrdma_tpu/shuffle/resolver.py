@@ -94,6 +94,12 @@ class TpuShuffleBlockResolver:
         with self._lock:
             return self._data.get(shuffle_id)
 
+    def shuffle_ids(self) -> List[int]:
+        """Snapshot of the shuffles with live local data (elastic
+        layer: the handoff path walks these to build its manifest)."""
+        with self._lock:
+            return sorted(self._data)
+
     def get_local_partition_streams(self, shuffle_id: int, partition_id: int) -> List[BinaryIO]:
         data = self.get_shuffle_data(shuffle_id)
         return data.get_input_streams(partition_id) if data is not None else []

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import BinaryIO, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu.locations import PartitionLocation
@@ -69,6 +69,25 @@ class WrapperShuffleData(ShuffleData):
     def get_mapped_file(self, map_id: int) -> MappedFile:
         with self._lock:
             return self._mapped[map_id]
+
+    def handoff_manifest(self) -> List[dict]:
+        """Elastic layer: describe every committed map output by file
+        path + per-partition lengths — everything the shuffle-service
+        daemon needs to re-mmap and re-register the same bytes
+        (elastic/service.py) without copying them."""
+        with self._lock:
+            items = sorted(self._mapped.items())
+        return [
+            {
+                "map_id": map_id,
+                "path": os.path.abspath(mf.path),
+                "partition_lengths": [
+                    mf.get_partition_location(pid).length
+                    for pid in range(mf.partition_count())
+                ],
+            }
+            for map_id, mf in items
+        ]
 
     def get_input_streams(self, partition_id: int) -> List[BinaryIO]:
         with self._lock:
@@ -124,7 +143,11 @@ class WrapperShuffleWriter:
         # map-output count completes
         mf = self._data.get_mapped_file(self.map_id)
         locs = [
-            PartitionLocation(self._manager.local_manager_id, pid, mf.get_partition_location(pid))
+            PartitionLocation(
+                self._manager.local_manager_id,
+                pid,
+                replace(mf.get_partition_location(pid), source_map=self.map_id),
+            )
             for pid in range(self._handle.num_partitions)
             if mf.get_partition_location(pid).length > 0
         ]
@@ -136,4 +159,9 @@ class WrapperShuffleWriter:
         self._manager.publish_partition_locations(
             self._handle.shuffle_id, -1, locs, num_map_outputs=1
         )
+        # elastic layer: best-effort replication of this map's bytes to
+        # peer executors (conf elastic.replicas; never a write failure)
+        client = getattr(self._manager, "replica_client", None)
+        if client is not None and locs:
+            client.replicate_map(self._handle.shuffle_id, self.map_id, mf)
         return MapStatus(self.map_id, self._lengths)

@@ -1,4 +1,5 @@
-"""Per-tenant byte quotas with backpressure — mempool and HBM arena.
+"""Per-tenant byte quotas with backpressure — mempool, HBM arena, and
+mapped-fetch page cache.
 
 A broker tracks *held* bytes per tenant for one resource (capacity is
 charged at ``get`` and released at ``put``/``free``, so spilling a
@@ -153,6 +154,10 @@ def install(conf) -> None:
     specs = {
         "mempool": (conf.tenancy_mempool_quota_bytes, "mempoolBytes"),
         "hbm": (conf.tenancy_hbm_quota_bytes, "hbmBytes"),
+        # mapped zero-copy fetches bypass the mempool entirely, so
+        # their page-cache footprint gets its own ledger (fetcher.py
+        # charges per mapped group, releases on delivery/failure)
+        "pagecache": (conf.tenancy_pagecache_quota_bytes, "pageCacheBytes"),
     }
     with _table_lock:
         for resource, (default_quota, key) in specs.items():

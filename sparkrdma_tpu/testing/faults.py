@@ -17,12 +17,17 @@ seeded, config-driven *fault plan* hooked at four seams:
     originals stay authoritative); ``corrupt`` fires at the endpoint's
     seal phase AFTER the merged checksum tag (reduce path must detect
     and fall back)
+  - ``exec``  — executor-death seam (engine/worker.py task entry):
+    ``exec:kill:N[:peer=<id>]`` hard-exits the worker process,
+    ``exec:hang:N`` wedges the task thread — the elastic layer's chaos
+    rig (docs/RESILIENCE.md "Elasticity")
 
 Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
 ``delay`` (sleep ``delay_ms`` then proceed), ``corrupt`` (flip one
 deterministic byte of the delivered payload — the checksum layer's
 adversary), ``drop`` (connection drop for verbs; silent message loss
-for sends/rpc).
+for sends/rpc), ``kill``/``hang`` (exec seam only: process death /
+live-but-stuck).
 
 Plans are spec strings — ``op:kind:count[:k=v[,k=v...]]`` joined with
 ``;`` — so they travel through conf keys (``tpu.shuffle.faultPlan`` +
@@ -51,8 +56,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-OPS = ("read", "send", "rpc", "stage", "push")
-KINDS = ("fail", "delay", "corrupt", "drop")
+OPS = ("read", "send", "rpc", "stage", "push", "exec")
+KINDS = ("fail", "delay", "corrupt", "drop", "kill", "hang")
 
 
 class InjectedFault(IOError):
@@ -319,6 +324,35 @@ class FaultPlan:
                     break
             return False
         return True  # fail/drop: lost push
+
+    def on_exec(self, peer: str = "", stage: str = "") -> None:
+        """Executor-death seam (engine/worker.py, fired at task entry —
+        the elastic layer's chaos rig, docs/RESILIENCE.md):
+
+        - ``exec:kill:N[:peer=<id>]`` — ``os._exit(1)``: the process
+          dies mid-task with no cleanup, exactly like an OOM kill or a
+          preempted node. The driver's peer-loss path plus the elastic
+          recovery in engine/cluster.py must carry the job.
+        - ``exec:hang:N`` — the task thread blocks for ``delay_ms``
+          (default 600 s, i.e. effectively forever at test scale): a
+          live process that stops making progress, the straggler
+          detector's prey.
+
+        Only ``kill``/``hang`` match here, so exec rules never burn
+        budget at other seams and vice versa. ``stage`` narrows the
+        rule to one task kind (``map_task``/``reduce_task``), e.g.
+        ``exec:kill:1:peer=proc-exec-1,stage=reduce_task`` kills that
+        executor at its first *reduce* — the mid-reduce chaos case."""
+        hit = self._match("exec", peer, stage=stage, kinds=("kill", "hang"))
+        if hit is None:
+            return
+        rule, _ = hit
+        logger.warning("fault plan: exec %s on %s", rule.kind, peer)
+        if rule.kind == "kill":
+            import os
+
+            os._exit(1)
+        time.sleep((rule.delay_ms or 600_000) / 1000.0)
 
 
 def _drop_channel(channel) -> None:

@@ -126,7 +126,12 @@ DECLARED_KNOBS: Dict[str, str] = {
     "tenancy.quantumMs": "DRR credit per round (ms per unit weight)",
     "tenancy.mempoolQuotaBytes": "per-tenant mempool byte quota (0 = off)",
     "tenancy.hbmQuotaBytes": "per-tenant HBM byte quota (0 = off)",
+    "tenancy.pageCacheQuotaBytes": "per-tenant mapped-fetch byte quota (0 = off)",
     "tenancy.quotaBlockMaxMs": "max quota backpressure stall",
+    "elastic.replicas": "map-output replicas pushed to peers (0 = off)",
+    "elastic.speculation": "clone straggler tasks onto healthy peers",
+    "elastic.speculationCheckMs": "straggler poll period while reducing",
+    "elastic.maxRecoveries": "executor-loss recoveries per stage",
 }
 
 # Knob families with a free segment (``<seg>`` = one dot-free token),
@@ -134,6 +139,7 @@ DECLARED_KNOBS: Dict[str, str] = {
 PATTERN_KNOBS = (
     "tenancy.quota.<seg>.mempoolBytes",
     "tenancy.quota.<seg>.hbmBytes",
+    "tenancy.quota.<seg>.pageCacheBytes",
 )
 
 
@@ -715,8 +721,46 @@ class TpuShuffleConf:
         return self._bytes("tenancy.hbmQuotaBytes", "0", 0, 1 << 44)
 
     @property
+    def tenancy_pagecache_quota_bytes(self) -> int:
+        """Per-tenant byte quota on in-flight zero-copy mapped fetches
+        (0 = off). Mapped delivery bypasses the mempool, so without
+        this a mapped-heavy tenant's page-cache footprint is invisible
+        to the other quotas. Per-tenant overrides:
+        ``tenancy.quota.<tenant>.pageCacheBytes``."""
+        return self._bytes("tenancy.pageCacheQuotaBytes", "0", 0, 1 << 44)
+
+    @property
     def tenancy_quota_block_max_ms(self) -> int:
         """Upper bound on one quota backpressure stall; past it the
         charge is admitted anyway (tenant.quota_overruns) — the quota
         is backpressure, never a wedge."""
         return self._int("tenancy.quotaBlockMaxMs", 60000, 1, 1 << 31)
+
+    # -- elastic (executor loss, speculation; sparkrdma_tpu/elastic) ------
+    @property
+    def elastic_replicas(self) -> int:
+        """Best-effort copies of each committed map output pushed to
+        this many ring peers (elastic/replication.py). 0 disables the
+        replication plane; with it on, losing an executor costs zero
+        recompute for every map a replica covers."""
+        return self._int("elastic.replicas", 0, 0, 16)
+
+    @property
+    def elastic_speculation(self) -> bool:
+        """Clone in-flight reduce ranges of a telemetry-flagged
+        straggler onto a healthy peer; first finisher wins, the loser
+        drains through the reader abort latch."""
+        return self._bool("elastic.speculation", False)
+
+    @property
+    def elastic_speculation_check_ms(self) -> int:
+        """How often the cluster driver polls straggler verdicts while
+        reduce tasks are in flight."""
+        return self._int("elastic.speculationCheckMs", 200, 10, 1 << 31)
+
+    @property
+    def elastic_max_recoveries(self) -> int:
+        """Executor-loss recovery rounds per stage before the job
+        fails. Each round re-runs only the dead executor's unaccounted
+        maps on survivors and re-issues its reduce ranges."""
+        return self._int("elastic.maxRecoveries", 2, 0, 64)

@@ -1,0 +1,459 @@
+"""Elastic cluster layer (docs/DESIGN.md §21, docs/RESILIENCE.md
+"Elasticity"): executor-loss survival, map-output replication,
+speculative execution, and the detachable shuffle-service daemon —
+plus the exec fault grammar and the page-cache quota ledger that ride
+along. The chaos cases run REAL worker processes and kill them with
+``os._exit`` mid-job; byte-identity of the final result is the bar."""
+
+import collections
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sparkrdma_tpu.engine.cluster import ClusterContext
+from sparkrdma_tpu.locations import (
+    BlockLocation,
+    PartitionLocation,
+    ShuffleManagerId,
+)
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.rpc import PublishPartitionLocationsMsg, RpcMsg
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.testing import faults as _faults
+from sparkrdma_tpu.testing.faults import FaultPlan, FaultRule
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+WORDS = ["tpu", "shuffle", "rdma", "mesh", "ici", "dcn"]
+
+
+# NOTE on closures: task functions must be created by factories (not
+# plain module-level defs) so cloudpickle serializes them BY VALUE —
+# worker subprocesses cannot import this test module by name.
+def _make_map(seed, n=600):
+    def fn():
+        for i in range(n):
+            yield (WORDS[(seed * 7 + i) % len(WORDS)], 1)
+
+    return fn
+
+
+def _counts_reducer():
+    def red(it):
+        acc = collections.Counter()
+        for k, v in it:
+            acc[k] += v
+        return dict(acc)
+
+    return red
+
+
+def _expected(num_maps, n=600):
+    expected = collections.Counter()
+    for s in range(num_maps):
+        for i in range(n):
+            expected[WORDS[(s * 7 + i) % len(WORDS)]] += 1
+    return expected
+
+
+def _merged(parts):
+    merged = collections.Counter()
+    for p in parts:
+        merged.update(p)
+    return merged
+
+
+def _collector():
+    def collect(it):
+        return sorted(it)
+
+    return collect
+
+
+# ----------------------------------------------------------------------
+# chaos: executor kill mid-reduce -> lineage recompute of ITS maps only
+# ----------------------------------------------------------------------
+def test_exec_kill_mid_reduce_recomputes_only_lost_maps():
+    """proc-exec-1 is hard-killed at its first REDUCE task entry. The
+    job must complete byte-identically; the recovery must re-run
+    exactly the two maps exec-1 owned (6 maps round-robined over 3
+    workers -> maps 1 and 4) and count ONE recompute event."""
+    reg = get_registry()
+    rec_maps0 = reg.counter("elastic.recomputed_maps", role="driver").value
+    recov0 = reg.counter("elastic.recoveries", role="driver").value
+    stage0 = reg.counter("engine.stage_recomputes").value
+
+    conf = TpuShuffleConf({
+        "tpu.shuffle.faultPlan": "exec:kill:1:peer=proc-exec-1,stage=reduce_task",
+    })
+    try:
+        with ClusterContext(num_executors=3, conf=conf) as cc:
+            parts = cc.run_map_reduce(
+                [_make_map(s) for s in range(6)], num_partitions=6,
+                reduce_fn=_counts_reducer(),
+            )
+            # the dead worker was pruned from the dispatch set
+            assert len(cc.workers) == 2
+    finally:
+        _faults.uninstall()
+
+    merged = _merged(parts)
+    assert sum(merged.values()) == 6 * 600
+    assert merged == _expected(6)
+    # recompute scoped to the killed executor's lineage: 2 maps, 1 event
+    assert reg.counter("elastic.recomputed_maps", role="driver").value - rec_maps0 == 2
+    assert reg.counter("elastic.recoveries", role="driver").value - recov0 == 1
+    assert reg.counter("engine.stage_recomputes").value - stage0 == 1
+
+
+# ----------------------------------------------------------------------
+# chaos: same kill, but replicas cover the loss -> ZERO recompute
+# ----------------------------------------------------------------------
+def test_exec_kill_with_replication_skips_recompute():
+    """With ``elastic.replicas=1`` every map output is mirrored to the
+    next peer in the ring. The same mid-reduce kill now costs zero
+    recomputed maps: the driver promotes exec-1's replicas and the
+    re-issued reduce range pulls from the replica holder."""
+    reg = get_registry()
+    rec_maps0 = reg.counter("elastic.recomputed_maps", role="driver").value
+    promos0 = reg.counter("elastic.replica_promotions", role="driver").value
+
+    conf = TpuShuffleConf({
+        "tpu.shuffle.faultPlan": "exec:kill:1:peer=proc-exec-1,stage=reduce_task",
+        "tpu.shuffle.elastic.replicas": "1",
+    })
+    try:
+        with ClusterContext(num_executors=3, conf=conf) as cc:
+            parts = cc.run_map_reduce(
+                [_make_map(s) for s in range(6)], num_partitions=6,
+                reduce_fn=_counts_reducer(),
+            )
+    finally:
+        _faults.uninstall()
+
+    assert _merged(parts) == _expected(6)
+    assert reg.counter("elastic.recomputed_maps", role="driver").value == rec_maps0
+    assert reg.counter("elastic.replica_promotions", role="driver").value > promos0
+
+
+# ----------------------------------------------------------------------
+# speculation: the delayed executor gets flagged and its range cloned
+# ----------------------------------------------------------------------
+def test_speculation_clones_flagged_straggler():
+    """proc-exec-2 is slowed at one map (feeding the telemetry
+    straggler detector a real busy-ms outlier) and then wedged for
+    2.5 s at its reduce. With speculation on, the driver's monitor
+    must flag exactly that executor, clone its in-flight range onto a
+    healthy peer, and take the clone's result — byte-identically."""
+    reg = get_registry()
+    specs0 = reg.counter("elastic.speculations", role="driver").value
+    wins0 = reg.counter("elastic.speculation_wins", role="driver").value
+
+    conf = TpuShuffleConf({
+        "tpu.shuffle.faultPlan": (
+            "stage:delay:1:peer=proc-exec-2,stage=map_task,delay_ms=1200;"
+            "stage:delay:1:peer=proc-exec-2,stage=reduce_task,delay_ms=2500"
+        ),
+        "tpu.shuffle.elastic.speculation": "true",
+        "tpu.shuffle.elastic.speculationCheckMs": "100",
+        "tpu.shuffle.obs.telemetry.intervalMs": "100",
+        "tpu.shuffle.obs.telemetry.stragglerZ": "1",
+    })
+    try:
+        with ClusterContext(num_executors=4, conf=conf) as cc:
+            parts = cc.run_map_reduce(
+                [_make_map(s) for s in range(8)], num_partitions=4,
+                reduce_fn=_counts_reducer(),
+            )
+            report = cc.driver.telemetry.straggler_report()
+            assert "proc-exec-2" in report["stragglers"]
+            assert "proc-exec-2" in report["suspect_keys"]
+    finally:
+        _faults.uninstall()
+
+    assert _merged(parts) == _expected(8)
+    assert reg.counter("elastic.speculations", role="driver").value > specs0
+    assert reg.counter("elastic.speculation_wins", role="driver").value > wins0
+
+
+# ----------------------------------------------------------------------
+# shuffle-service daemon: handoff, then survive the executor's death
+# ----------------------------------------------------------------------
+def test_shuffle_service_handoff_survives_executor_kill():
+    """A detached ``python -m sparkrdma_tpu.elastic.service`` process
+    adopts proc-exec-0's committed map outputs (hard links + re-mmap,
+    no byte copy). While the executor lives the daemon is invisible;
+    after a SIGKILL + peer-loss the daemon's locations are promoted
+    and the surviving worker reads the SAME bytes from the daemon."""
+    from sparkrdma_tpu.elastic.service import _recv_obj, _send_obj
+    import socket as socket_mod
+
+    def svc_request(port, obj):
+        with socket_mod.create_connection(("127.0.0.1", port), timeout=10) as s:
+            s.settimeout(10)
+            _send_obj(s, obj)
+            return _recv_obj(s)
+
+    svc = None
+    try:
+        with ClusterContext(num_executors=2) as cc:
+            handle = BaseShuffleHandle(
+                shuffle_id=cc._next_shuffle_id(),
+                num_maps=4,
+                partitioner=HashPartitioner(4),
+            )
+            cc.driver.register_shuffle(handle)
+            items = list(enumerate(_make_map(s, n=300) for s in range(4)))
+            cc._run_map_phase(handle, items, "default", recompute=False)
+
+            def read_all(worker):
+                return worker.request({
+                    "kind": "reduce", "handle": handle, "start": 0, "end": 4,
+                    "reduce_fn": _collector(), "tenant": "default",
+                })
+
+            baseline = read_all(cc.workers[1])
+            assert len(baseline) == 4 * 300
+
+            conf_json = json.dumps(cc.conf.to_dict())
+            svc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "sparkrdma_tpu.elastic.service",
+                    "--service-id", "svc-test", "--conf", conf_json,
+                ],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            )
+            deadline = time.monotonic() + 30
+            port = None
+            while time.monotonic() < deadline:
+                line = svc.stdout.readline()
+                if not line:
+                    raise RuntimeError("service daemon exited before announcing")
+                if line.startswith("SERVICE_PORT "):
+                    port = int(line.split()[1])
+                    break
+            assert port is not None
+            assert svc_request(port, {"kind": "ping"})["result"] == "pong"
+
+            # executor 0 hands its blocks over: metadata only, the
+            # daemon republishes them as replicas (parked, invisible)
+            adopted = cc.workers[0].request(
+                {"kind": "handoff", "service": ("127.0.0.1", port)}
+            )
+            assert adopted == 2  # exec-0 owned maps 0 and 2
+            assert read_all(cc.workers[1]) == baseline  # still invisible
+
+            # now the executor dies; the daemon's copies get promoted
+            w0 = cc.workers[0]
+            w0.proc.kill()
+            w0.proc.wait(timeout=10)
+            dead = cc._reap_dead()
+            assert [w.executor_id for w in dead] == ["proc-exec-0"]
+
+            assert read_all(cc.workers[0]) == baseline  # survivor reads daemon
+
+            assert svc_request(port, {"kind": "stop"})["ok"]
+            svc.wait(timeout=15)
+            svc = None
+    finally:
+        if svc is not None:
+            svc.kill()
+
+
+# ----------------------------------------------------------------------
+# fault grammar: the exec seam
+# ----------------------------------------------------------------------
+def test_exec_fault_rule_parse():
+    r = FaultRule.parse("exec:kill:1:peer=proc-exec-1,stage=reduce_task")
+    assert (r.op, r.kind, r.count) == ("exec", "kill", 1)
+    assert r.peer == "proc-exec-1" and r.stage == "reduce_task"
+    r = FaultRule.parse("exec:hang:2:delay_ms=50")
+    assert (r.op, r.kind, r.count, r.delay_ms) == ("exec", "hang", 2, 50)
+    with pytest.raises(ValueError):
+        FaultRule.parse("exec:explode:1")
+
+
+def test_exec_hang_blocks_for_delay():
+    plan = FaultPlan.parse("exec:hang:1:delay_ms=30")
+    t0 = time.perf_counter()
+    plan.on_exec("exec-0", stage="map_task")
+    assert time.perf_counter() - t0 >= 0.025
+    assert plan.injected_count("exec", "hang") == 1
+    # budget exhausted: the next entry sails through instantly
+    t0 = time.perf_counter()
+    plan.on_exec("exec-0", stage="map_task")
+    assert time.perf_counter() - t0 < 0.02
+
+
+def test_exec_kill_filters_never_fire_off_target():
+    """A kill rule scoped by peer/stage must NOT fire elsewhere — if
+    it did, this test process would be dead (os._exit)."""
+    plan = FaultPlan.parse("exec:kill:1:peer=proc-exec-9,stage=reduce_task")
+    plan.on_exec("proc-exec-1", stage="reduce_task")  # wrong peer
+    plan.on_exec("proc-exec-9", stage="map_task")  # wrong stage
+    assert plan.injected_count("exec", "kill") == 0
+    # non-exec rules never burn budget at the exec seam and vice versa
+    plan2 = FaultPlan.parse("read:fail:1")
+    plan2.on_exec("proc-exec-1", stage="map_task")
+    assert plan2.total_injected == 0
+
+
+# ----------------------------------------------------------------------
+# page-cache quota ledger (mapped zero-copy fetches)
+# ----------------------------------------------------------------------
+def test_pagecache_quota_broker_install_and_ledger():
+    from sparkrdma_tpu.tenancy import quota
+
+    quota.reset()
+    try:
+        # unconfigured -> no broker, the mapped fetch path stays free
+        quota.install(TpuShuffleConf())
+        assert quota.broker("pagecache") is None
+        quota.reset()
+
+        conf = TpuShuffleConf({"tpu.shuffle.tenancy.pageCacheQuotaBytes": "1m"})
+        quota.install(conf)
+        b = quota.broker("pagecache")
+        assert b is not None
+        b.charge("tenant-a", 512 * 1024)
+        assert b.usage("tenant-a") == 512 * 1024
+        b.release("tenant-a", 512 * 1024)
+        assert b.usage("tenant-a") == 0
+    finally:
+        quota.reset()
+
+
+# ----------------------------------------------------------------------
+# wire: the elastic trailing extension
+# ----------------------------------------------------------------------
+def _loc(pid, length, mkey, replica_of="", source_map=-1, eid="e"):
+    return PartitionLocation(
+        ShuffleManagerId("host", 1234, eid),
+        pid,
+        BlockLocation(
+            0, length, mkey, replica_of=replica_of, source_map=source_map
+        ),
+    )
+
+
+def test_publish_msg_elastic_extension_roundtrip():
+    locs = [
+        _loc(0, 100, 7, replica_of="proc-exec-1", source_map=3, eid="svc"),
+        _loc(1, 200, 8),
+    ]
+    msg = PublishPartitionLocationsMsg(5, -1, locs, trace_id=0xE1A)
+    out = [RpcMsg.parse_segment(s) for s in msg.to_segments(4096)]
+    got = sorted(
+        (loc for m in out for loc in m.locations),
+        key=lambda l: l.partition_id,
+    )
+    assert got[0].block.replica_of == "proc-exec-1"
+    assert got[0].block.source_map == 3
+    assert got[0].block.is_replica
+    assert not got[1].block.is_replica and got[1].block.source_map == -1
+    assert all(m.trace_id == 0xE1A for m in out)
+
+
+def test_publish_msg_without_elastic_tags_is_byte_identical_legacy():
+    locs = [_loc(0, 64, 3), _loc(1, 64, 4)]
+    msg = PublishPartitionLocationsMsg(2, -1, locs)
+    baseline = PublishPartitionLocationsMsg(
+        2, -1,
+        [
+            PartitionLocation(
+                l.manager_id, l.partition_id,
+                BlockLocation(l.block.address, l.block.length, l.block.mkey),
+            )
+            for l in locs
+        ],
+    )
+    assert msg.to_segments(4096) == baseline.to_segments(4096)
+
+
+def test_publish_msg_elastic_ext_survives_segmentation():
+    """Replica identities stay attached to THEIR location across
+    segment splits (per-segment extension tables, variable items)."""
+    locs = [
+        _loc(i, 10 + i, 100 + i, replica_of=f"proc-exec-{i % 4}",
+             source_map=i, eid="svc")
+        for i in range(40)
+    ]
+    msg = PublishPartitionLocationsMsg(9, -1, locs)
+    segments = msg.to_segments(256)
+    assert len(segments) > 1
+    got = []
+    for seg in segments:
+        got.extend(RpcMsg.parse_segment(seg).locations)
+    assert len(got) == 40
+    for i, l in enumerate(sorted(got, key=lambda x: x.partition_id)):
+        assert l.block.replica_of == f"proc-exec-{i % 4}"
+        assert l.block.source_map == i
+
+
+# ----------------------------------------------------------------------
+# advisory plumbing: tenant-scoped suspect keys
+# ----------------------------------------------------------------------
+def test_health_registry_applies_suspect_keys():
+    from sparkrdma_tpu.resilience.health import SourceHealthRegistry
+
+    reg = SourceHealthRegistry(TpuShuffleConf(), role="t")
+    reg.apply_straggler_report({
+        "suspect_keys": ["proc-exec-2", "team-b:proc-exec-3"],
+        "stragglers": ["ignored-when-keys-present"],
+        "generated_wall_ms": 1,
+    })
+    assert set(reg.suspects()) == {"proc-exec-2", "team-b:proc-exec-3"}
+    # a suspect never opens the circuit: advisory only
+    assert reg.allow("proc-exec-2")
+    # older hubs without suspect_keys fall back to the bare list
+    reg.apply_straggler_report({"stragglers": ["proc-exec-4"]})
+    assert set(reg.suspects()) == {"proc-exec-4"}
+    # and an empty report clears the slate
+    reg.apply_straggler_report({"suspect_keys": []})
+    assert reg.suspects() == {}
+
+
+# ----------------------------------------------------------------------
+# in-process engine: executor loss behind the partition router
+# ----------------------------------------------------------------------
+def test_inprocess_context_survives_executor_loss_with_replication():
+    """TpuContext.lose_executor: with ring replication on, dropping an
+    executor after the map stage leaves the shuffle fully covered by
+    promoted replicas — a re-read of the same materialized shuffle
+    completes byte-identically with zero stage recomputes."""
+    from sparkrdma_tpu.engine.context import TpuContext
+
+    conf = TpuShuffleConf({"tpu.shuffle.elastic.replicas": "1"})
+    ctx = TpuContext(num_executors=3, conf=conf)
+    try:
+        words = [WORDS[i % 6] for i in range(3000)]
+        rdd = (
+            ctx.parallelize(words, 6)
+            .map(lambda w: (w, 1))
+            .reduce_by_key(lambda a, b: a + b)
+        )
+        expected = dict(collections.Counter(words))
+        assert dict(rdd.collect()) == expected  # materializes the shuffle
+
+        reg = get_registry()
+        recomputes0 = reg.counter("engine.stage_recomputes").value
+        promos0 = reg.counter(
+            "elastic.replica_promotions", role=ctx.driver.executor_id
+        ).value
+
+        ctx.lose_executor(ctx.executors[1].executor_id)
+        assert len(ctx.executors) == 2
+
+        # same materialized shuffle, re-read through the survivors
+        assert dict(rdd.collect()) == expected
+        assert reg.counter("engine.stage_recomputes").value == recomputes0
+        assert (
+            reg.counter(
+                "elastic.replica_promotions", role=ctx.driver.executor_id
+            ).value
+            > promos0
+        )
+    finally:
+        ctx.stop()
