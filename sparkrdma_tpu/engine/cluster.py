@@ -366,7 +366,22 @@ class ClusterContext:
             size_map = self.driver.partition_sizes(handle.shuffle_id)
             sizes = [size_map.get(p, 0) for p in range(num_partitions)]
             if any(sizes):
-                ranges = AdaptivePartitioner(self.conf).plan(sizes, n)
+                lane_sizes = None
+                if self.conf.collective_lane_balance:
+                    # per-source lanes: cuts balance DMA-lane occupancy
+                    # (the collective schedule's wave wall), not just
+                    # byte totals
+                    lanes = self.driver.partition_lane_sizes(
+                        handle.shuffle_id
+                    )
+                    if len(lanes) > 1:
+                        lane_sizes = {
+                            src: [per.get(p, 0) for p in range(num_partitions)]
+                            for src, per in lanes.items()
+                        }
+                ranges = AdaptivePartitioner(self.conf).plan(
+                    sizes, n, lane_sizes=lane_sizes
+                )
                 # pad with empty ranges so every worker keeps a slot
                 bounds = ranges + [
                     (num_partitions, num_partitions)

@@ -49,6 +49,18 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "admission.wait_ms": ("histogram", _L({"tenant"})),
     "admission.inflight": ("gauge", _L({"role"})),
     "admission.queue_depth": ("gauge", _L({"role"})),
+    # whole-stage collective shuffle (shuffle/collective.py, planner.py)
+    "collective.plans": ("counter", _L({"role"})),
+    "collective.waves": ("counter", _L({"role", "schedule"})),
+    "collective.blocks": ("counter", _L({"role"})),
+    "collective.bytes": ("counter", _L({"role"})),
+    "collective.fused_merges": ("counter", _L({"role"})),
+    "collective.degrades": ("counter", _L({"role"})),
+    "collective.compiles": ("counter", _L({"role"})),
+    "collective.cache_hits": ("counter", _L({"role"})),
+    "collective.lane_plans": ("counter", _L({"role"})),
+    "collective.plan_ms": ("histogram", _L({"role"})),
+    "collective.wave_ms": ("histogram", _L({"role", "schedule"})),
     # device fetch plane (shuffle/device_fetch.py, device_io.py)
     "device_fetch.bytes": ("counter", _L()),
     "device_fetch.stage_ms": ("histogram", _L()),

@@ -291,6 +291,11 @@ class TelemetryHub:
         # (shuffle/planner.py). Bounded: oldest shuffle evicted.
         self._partition_bytes: Dict[int, Dict[int, int]] = {}
         self._partition_bytes_max_shuffles = 64
+        # same totals split by SOURCE executor (the DMA "lane" of the
+        # whole-stage collective schedule): shuffle -> source -> pid ->
+        # bytes. Feeds the planner's lane-balanced cuts; bounded with
+        # and evicted alongside _partition_bytes.
+        self._partition_lane_bytes: Dict[int, Dict[str, Dict[int, int]]] = {}
         self._last_file_write_ms = 0
         self.last_flight_path: Optional[str] = None
         self.last_flight: Optional[dict] = None
@@ -310,24 +315,43 @@ class TelemetryHub:
             )
 
     # -- per-partition skew statistics (adaptive planner input) --------
-    def record_partition_bytes(self, shuffle_id: int, pid: int, nbytes: int) -> None:
-        """Accumulate one published location's bytes for (shuffle, pid)."""
+    def record_partition_bytes(
+        self, shuffle_id: int, pid: int, nbytes: int, source: str = ""
+    ) -> None:
+        """Accumulate one published location's bytes for (shuffle, pid).
+
+        ``source`` (the publishing executor id) additionally files the
+        bytes under that DMA lane for the planner's lane-balanced cuts;
+        empty keeps the pre-existing totals-only accounting."""
         with self._lock:
             per = self._partition_bytes.get(shuffle_id)
             if per is None:
                 while len(self._partition_bytes) >= self._partition_bytes_max_shuffles:
-                    self._partition_bytes.pop(next(iter(self._partition_bytes)))
+                    old = next(iter(self._partition_bytes))
+                    self._partition_bytes.pop(old)
+                    self._partition_lane_bytes.pop(old, None)
                 per = self._partition_bytes[shuffle_id] = {}
             per[pid] = per.get(pid, 0) + int(nbytes)
+            if source:
+                lanes = self._partition_lane_bytes.setdefault(shuffle_id, {})
+                lane = lanes.setdefault(source, {})
+                lane[pid] = lane.get(pid, 0) + int(nbytes)
 
     def partition_bytes(self, shuffle_id: int) -> Dict[int, int]:
         """Per-partition byte totals observed so far for one shuffle."""
         with self._lock:
             return dict(self._partition_bytes.get(shuffle_id, ()))
 
+    def partition_lane_bytes(self, shuffle_id: int) -> Dict[str, Dict[int, int]]:
+        """Per-source per-partition byte totals (source -> pid -> bytes)."""
+        with self._lock:
+            lanes = self._partition_lane_bytes.get(shuffle_id, {})
+            return {src: dict(per) for src, per in lanes.items()}
+
     def drop_partition_bytes(self, shuffle_id: int) -> None:
         with self._lock:
             self._partition_bytes.pop(shuffle_id, None)
+            self._partition_lane_bytes.pop(shuffle_id, None)
 
     # -- ingest --------------------------------------------------------
     def ingest(self, payload: Mapping) -> None:

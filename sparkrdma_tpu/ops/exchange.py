@@ -60,6 +60,17 @@ def round_bucket(nbytes: int, lo: int = MIN_BUCKET, hi: int = 1 << 31) -> int:
     return 1 << max(n - 1, 1).bit_length() if n > lo else lo
 
 
+def round_rows(rows: int, lo: int = 1) -> int:
+    """Round a row count up to its power-of-two bucket class — the
+    leading-axis twin of :func:`round_bucket`. Ragged stage sizes
+    (distinct per-peer row counts, distinct wave populations) pad up to
+    the class and reuse one cached executable instead of recompiling
+    per distinct count; pad rows travel with a zero length prefix and
+    are sliced off after the exchange."""
+    n = max(lo, rows)
+    return 1 << max(n - 1, 1).bit_length() if n > lo else lo
+
+
 def pack_blocks(
     blocks: Sequence[bytes], block_bytes: int
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -236,13 +247,53 @@ class ExchangeProgram:
 
         ``send``: [E*rows_per_shard, block] (any dtype), sharded or
         shardable over the mesh; ``counts``: [E*rows_per_shard] int32.
+
+        Rows-per-peer are bucketed to power-of-two classes
+        (:func:`round_rows`) the same way block bytes are: a ragged
+        stage whose shards stage 3 then 5 then 4 blocks per peer
+        compiles TWO executables (classes 4 and 8), not three — pad
+        rows ride with a zero length prefix and are sliced off before
+        returning, so results are byte-identical to the exact-shape
+        program. Bucketing applies only to fully-addressable inputs
+        whose rows divide evenly by E; the multi-host path (caller
+        builds non-addressable global arrays from process-local
+        shards) keeps exact shapes — padding there would need a
+        cross-process layout agreement this entry point cannot make.
         """
-        rows = send.shape[0] // self.num_shards
+        e = self.num_shards
+        rows = send.shape[0] // e
+        addressable = not (
+            isinstance(send, jax.Array) and not send.is_fully_addressable
+        )
+        rpp = rows // e if (addressable and rows % e == 0 and rows > 0) else 0
+        pad = 0
+        if rpp > 0:
+            rb = round_rows(rpp)
+            pad = rb - rpp
+            if pad:
+                block = send.shape[1]
+                s = np.asarray(send).reshape(e, e, rpp, block)
+                c = np.asarray(counts).reshape(e, e, rpp)
+                s = np.pad(s, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                c = np.pad(c, ((0, 0), (0, 0), (0, pad)))
+                send = s.reshape(e * e * rb, block)
+                counts = c.reshape(-1)
+                rows = e * rb
         fn = self.program_for(rows, send.shape[1], send.dtype)
         send, counts = self._placed(send, counts)
         t0 = time.perf_counter()
         recv, rcounts = fn(send, counts)
-        return self._account("a2a", send, recv, rcounts, t0)
+        recv, rcounts = self._account("a2a", send, recv, rcounts, t0)
+        if pad:
+            # receivers see each peer's chunk padded at its tail; strip
+            # the pad rows so callers get the exact-shape result back
+            rb = rpp + pad
+            block = recv.shape[1]
+            r = np.asarray(recv).reshape(e, e, rb, block)[:, :, :rpp]
+            rc = np.asarray(rcounts).reshape(e, e, rb)[:, :, :rpp]
+            recv = r.reshape(e * e * rpp, block)
+            rcounts = rc.reshape(-1)
+        return recv, rcounts
 
     # -- schedule 2: staged ring (ppermute) --------------------------------
     def _build_ring(self, block: int, dtype) -> "jax.stages.Wrapped":

@@ -143,6 +143,108 @@ def pallas_neighbor_pull(sharded_blocks):
     return prog(sharded_blocks)
 
 
+@functools.lru_cache(maxsize=64)
+def _wave_pull_program(axis_size: int, rows: int, bucket_elems: int,
+                       dtype_str: str):
+    """Jitted shard_map'd Pallas program moving a whole fetch WAVE in
+    one kernel epoch: ``rows`` one-sided remote DMAs started together,
+    waited together — the batched multi-block pull the per-block
+    ``_neighbor_pull_program`` is the building block for. Row *i*'s
+    source device rides in a scalar-prefetch lane (the WR list's
+    per-entry rkey analogue), so one executable serves every wave of
+    the same (rows, bucket) class regardless of which peers it names.
+
+    Cached per (mesh size, bucketed rows, bucket elems, dtype) — the
+    shuffle-schedule compiler buckets both axes so ragged stages reuse
+    these executables (DESIGN.md §22)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from sparkrdma_tpu.utils.jax_compat import shard_map
+
+    dtype = jnp.dtype(dtype_str)
+
+    def kernel(src_ids, src_ref, dst_ref, send_sem, recv_sem):
+        def start(i, _):
+            op = pltpu.make_async_remote_copy(
+                src_ref=src_ref.at[i],
+                dst_ref=dst_ref.at[i],
+                send_sem=send_sem.at[i],
+                recv_sem=recv_sem.at[i],
+                device_id=(src_ids[i],),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            op.start()
+            return _
+
+        def wait(i, _):
+            op = pltpu.make_async_remote_copy(
+                src_ref=src_ref.at[i],
+                dst_ref=dst_ref.at[i],
+                send_sem=send_sem.at[i],
+                recv_sem=recv_sem.at[i],
+                device_id=(src_ids[i],),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            op.wait()
+            return _
+
+        # every DMA in flight before the first wait: the epoch's wall
+        # is max(row latency), not sum — the whole point of the wave
+        jax.lax.fori_loop(0, rows, start, 0)
+        jax.lax.fori_loop(0, rows, wait, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=(
+            [pltpu.SemaphoreType.DMA((rows,))] * 2
+        ),
+    )
+
+    pull = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, bucket_elems), dtype),
+        grid_spec=grid_spec,
+    )
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(jax.devices()[:axis_size], ("x",))
+    f = shard_map(
+        pull, mesh=mesh, in_specs=(P(), P("x")), out_specs=P("x"),
+        check_rep=False,
+    )
+    return jax.jit(f)
+
+
+def pallas_wave_pull(src_ids, stacked_sharded):
+    """Run one wave's batched remote pull over a sharded [n*rows, b]
+    array; ``src_ids`` is the int32 per-row source-device lane. TPU
+    meshes only — the schedule compiler gates on ``is_tpu_mesh()`` and
+    uses :func:`emulated_wave_pull` otherwise."""
+    if not is_tpu_mesh():
+        raise RuntimeError("pallas_wave_pull requires a TPU mesh")
+    n = mesh_device_count()
+    rows = stacked_sharded.shape[0] // n
+    prog = _wave_pull_program(
+        n, rows, stacked_sharded.shape[1], str(stacked_sharded.dtype)
+    )
+    return prog(src_ids, stacked_sharded)
+
+
+def emulated_wave_pull(stacked_host, dst_device):
+    """Off-TPU wave mover: land an assembled [rows, bucket] stack on
+    the destination in ONE transfer-engine dispatch — the emulated
+    counterpart of one batched-DMA kernel epoch, and the reason the
+    compiled schedule beats per-block ``emulated_pull`` loops even on
+    the CPU mesh (one dispatch + one sync per wave, not per block)."""
+    pulled = jax.device_put(stacked_host, dst_device)
+    jax.block_until_ready(pulled)
+    return pulled
+
+
 def pull_block(src_array, dst_device) -> Optional[object]:
     """Best-effort single-block pull used by the planner.
 

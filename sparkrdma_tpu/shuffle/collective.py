@@ -1,0 +1,598 @@
+"""Whole-stage collective shuffle — the shuffle-schedule compiler.
+
+The device fetch plane (DESIGN.md §17) moves one block per planner
+decision: pin, pull, adopt, repeat. This module treats a reduce
+stage's ENTIRE published location set as one object to compile: every
+device-resident block (0xFFFE extension coordinates) is grouped into
+batched DMA *waves* — fixed-shape [rows, bucket] stacks moved in one
+mover dispatch — over a ring or all-to-all schedule, with compile-once
+programs cached by (rows-class, bucket-class, dtype) exactly like the
+exchange executable cache (DESIGN.md §22).
+
+Movers, by regime:
+
+- TPU mesh: ``ops/remote_copy.pallas_wave_pull`` — one Pallas kernel
+  epoch issuing ``rows`` ``make_async_remote_copy`` DMAs together
+  (start all, wait all), per-row source device ids in a
+  scalar-prefetch lane so one executable serves any peer set.
+- Everywhere else (and on any TPU-side surprise): an assembled host
+  stack lands on the destination in ONE transfer-engine dispatch
+  (``emulated_wave_pull``) — still one dispatch + one sync per wave
+  instead of per block, which is why the compiled schedule beats the
+  per-block pull loop even on the CPU mesh.
+
+Fusion: a partition whose every block rides in one wave can merge in
+the same epoch — a cached compaction program gathers the wave's valid
+prefixes into one contiguous slab, so the partition lands as ONE
+merged device buffer (concatenated in deterministic source order,
+composing with the merged-cover contract of shuffle/merge.py) with no
+intermediate HBM round trip. Fusion changes the result SHAPE (one
+buffer per partition), so callers opt in per fetch.
+
+Degrade ladder (every rung silent, byte-identical):
+
+| condition                                   | outcome             |
+|---------------------------------------------|---------------------|
+| ``collective.enabled`` off                   | per-block planner   |
+| < ``collective.minBlocks`` device blocks     | per-block planner   |
+| block fails eligibility (size/dtype/arena)   | per-block planner   |
+| slab evicted/spilled between plan and pin    | host triple, degrade++ |
+| wave mover fails                             | host triple, degrade++ |
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from contextlib import ExitStack
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.analysis.lockorder import named_lock
+from sparkrdma_tpu.locations import PartitionLocation
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.ops import remote_copy
+from sparkrdma_tpu.ops.exchange import round_bucket, round_rows
+from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+from sparkrdma_tpu.shuffle.device_fetch import visible_arena
+
+logger = logging.getLogger(__name__)
+
+
+def merge_order_key(loc: PartitionLocation) -> Tuple:
+    """Deterministic within-partition merge order — the order fused
+    slabs concatenate in, and the order tests/benches sort per-block
+    results into when comparing against a fused result."""
+    return (
+        loc.manager_id.executor_id,
+        loc.block.mkey,
+        loc.block.address,
+        loc.block.arena_handle,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _compaction_program(rows_b: int, bucket_elems: int, dtype_str: str):
+    """Jitted fetch->merge compaction: gather every row's valid prefix
+    of a landed [rows_b, bucket_elems] wave into one contiguous flat
+    lane — the merge half of the fused epoch. Pure gather math (no
+    dynamic shapes): position j belongs to the row whose element span
+    covers it, looked up against the inclusive end-offsets lane. On
+    TPU, XLA keeps the gather in the same HBM residency as the landed
+    wave — fetch to merged slab with no host round trip.
+
+    Cached per (rows class, bucket class, dtype); rows and buckets are
+    both power-of-two bucketed upstream, so ragged stages reuse these
+    executables."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype_str)
+    total = rows_b * bucket_elems
+
+    def fn(stacked, starts, ends):
+        j = jnp.arange(total, dtype=jnp.int32)
+        row = jnp.searchsorted(ends, j, side="right")
+        row = jnp.minimum(row, rows_b - 1)
+        col = jnp.clip(j - starts[row], 0, bucket_elems - 1)
+        return stacked[row, col]
+
+    return jax.jit(fn)
+
+
+class _Row:
+    """One device-resident block scheduled into a wave."""
+
+    __slots__ = ("loc", "elems", "live")
+
+    def __init__(self, loc: PartitionLocation, elems: int):
+        self.loc = loc
+        self.elems = elems
+        self.live = True
+
+
+class CollectiveWave:
+    """One batched mover dispatch: ``rows`` blocks of one bucket class."""
+
+    __slots__ = ("rows", "bucket_elems", "rows_b", "lane")
+
+    def __init__(self, rows: List[_Row], bucket_elems: int, lane: str):
+        self.rows = rows
+        self.bucket_elems = bucket_elems
+        self.rows_b = round_rows(len(rows))
+        self.lane = lane  # primary source executor (ring ordering key)
+
+
+class CollectivePlan:
+    """A compiled reduce-stage fetch schedule.
+
+    ``passthrough`` locations never entered the schedule (collective
+    off, too few device blocks, or per-block ineligibility) — the
+    caller runs them through the pre-existing per-block loop, which
+    preserves exactly the old behavior when the compiler declines."""
+
+    __slots__ = ("schedule", "waves", "passthrough", "fusable_pids",
+                 "device_blocks")
+
+    def __init__(self, schedule: str, waves: List[CollectiveWave],
+                 passthrough: List[PartitionLocation],
+                 fusable_pids: frozenset, device_blocks: int):
+        self.schedule = schedule
+        self.waves = waves
+        self.passthrough = passthrough
+        self.fusable_pids = fusable_pids
+        self.device_blocks = device_blocks
+
+
+class CollectiveResult:
+    """One landed slab: a single block, or a fused per-partition merge
+    (``fused`` — ``locs`` then lists every covered block in merge
+    order and ``dev.length`` is their summed payload)."""
+
+    __slots__ = ("pid", "dev", "locs", "fused")
+
+    def __init__(self, pid: int, dev: DeviceBuffer,
+                 locs: List[PartitionLocation], fused: bool):
+        self.pid = pid
+        self.dev = dev
+        self.locs = locs
+        self.fused = fused
+
+
+class ShuffleScheduleCompiler:
+    """Compile + execute whole-stage device fetch schedules."""
+
+    def __init__(self, conf, dev: DeviceBufferManager, executor_id: str,
+                 tracer=None):
+        self._conf = conf
+        self._dev = dev
+        self._executor_id = executor_id
+        self._tracer = tracer
+        # program-cache bookkeeping (the lru_caches hold the programs;
+        # this counts resolutions for the compile-churn metrics)
+        self._seen_programs: set = set()
+        self._cache_lock = named_lock("collective.compiler")
+        reg = get_registry()
+        role = executor_id
+        self._m_plans = reg.counter("collective.plans", role=role)
+        self._m_blocks = reg.counter("collective.blocks", role=role)
+        self._m_bytes = reg.counter("collective.bytes", role=role)
+        self._m_fused = reg.counter("collective.fused_merges", role=role)
+        self._m_degrades = reg.counter("collective.degrades", role=role)
+        self._m_compiles = reg.counter("collective.compiles", role=role)
+        self._m_cache_hits = reg.counter("collective.cache_hits", role=role)
+        self._m_plan_ms = reg.histogram("collective.plan_ms", role=role)
+        # the device-fetch plane's counters stay the one source of truth
+        # for "blocks that moved HBM->HBM" vs "device offers declined":
+        # a landed wave row IS a device pull, a degraded row IS a
+        # fallback. collective.* adds the schedule-level detail on top.
+        self._m_plane_pulls = reg.counter(
+            "device_fetch.plane.pulls", role=role
+        )
+        self._m_plane_bytes = reg.counter(
+            "device_fetch.plane.bytes", role=role
+        )
+        self._m_plane_fallbacks = reg.counter(
+            "device_fetch.plane.fallbacks", role=role
+        )
+
+    # ------------------------------------------------------------------
+    # plan
+    # ------------------------------------------------------------------
+    def plan(self, locations: Sequence[PartitionLocation],
+             dtype=np.uint8) -> CollectivePlan:
+        """Compile the stage's location set into a wave schedule.
+
+        Eligibility here mirrors the per-block planner's static checks
+        (device extension present, above minBlockBytes, source arena
+        mesh-visible) plus an elem-alignment check the stacked layout
+        needs; residency/dtype are re-checked under the pin at execute
+        time, where a miss degrades to the host triple."""
+        t0 = time.perf_counter()
+        conf = self._conf
+        itemsize = np.dtype(dtype).itemsize
+        if not conf.collective_enabled or not conf.device_fetch_enabled:
+            return CollectivePlan("off", [], list(locations), frozenset(), 0)
+        min_bytes = conf.device_fetch_min_block_bytes
+        eligible: List[PartitionLocation] = []
+        passthrough: List[PartitionLocation] = []
+        per_pid_total: Dict[int, int] = {}
+        for loc in locations:
+            per_pid_total[loc.partition_id] = (
+                per_pid_total.get(loc.partition_id, 0) + 1
+            )
+            b = loc.block
+            if (
+                b.has_device
+                and b.length >= min_bytes
+                and b.length % itemsize == 0
+                and b.arena_offset % itemsize == 0
+                and visible_arena(loc.manager_id.executor_id) is not None
+            ):
+                eligible.append(loc)
+            else:
+                passthrough.append(loc)
+        if len(eligible) < conf.collective_min_blocks:
+            # too small a stage for a wave: the per-block planner keeps
+            # the whole set (it may still pull the stragglers one by one)
+            return CollectivePlan(
+                "off", [], list(locations), frozenset(), 0
+            )
+
+        # merge order: partition-major so a fused pid's rows are
+        # contiguous, source-ordered within the partition
+        eligible.sort(key=lambda loc: (loc.partition_id, merge_order_key(loc)))
+        per_pid_eligible: Dict[int, int] = {}
+        for loc in eligible:
+            per_pid_eligible[loc.partition_id] = (
+                per_pid_eligible.get(loc.partition_id, 0) + 1
+            )
+
+        lanes = sorted({loc.manager_id.executor_id for loc in eligible})
+        schedule = conf.collective_schedule
+        if schedule == "auto":
+            schedule = "a2a" if len(lanes) > 2 else "ring"
+
+        # wave formation: pid-group granularity (fusion needs a pid's
+        # rows in ONE wave), split only when a single pid alone
+        # overflows the wave budget (that pid becomes unfusable)
+        wave_budget = conf.collective_wave_bytes
+        waves: List[CollectiveWave] = []
+        fusable: set = set()
+        cur_rows: List[_Row] = []
+        cur_max_len = 0
+
+        def seal():
+            nonlocal cur_rows, cur_max_len
+            if cur_rows:
+                bucket = round_bucket(cur_max_len)
+                waves.append(CollectiveWave(
+                    cur_rows, bucket // itemsize,
+                    cur_rows[0].loc.manager_id.executor_id,
+                ))
+                cur_rows, cur_max_len = [], 0
+
+        i = 0
+        n = len(eligible)
+        while i < n:
+            pid = eligible[i].partition_id
+            j = i
+            group_bytes = 0
+            group_max = 0
+            while j < n and eligible[j].partition_id == pid:
+                group_bytes += round_bucket(eligible[j].block.length)
+                group_max = max(group_max, eligible[j].block.length)
+                j += 1
+            group = eligible[i:j]
+            if group_bytes > wave_budget and len(group) > 1:
+                # oversized pid: seal what we have, stream the pid
+                # through dedicated waves, leave it unfusable
+                seal()
+                for loc in group:
+                    cur_rows.append(_Row(loc, loc.block.length // itemsize))
+                    cur_max_len = max(cur_max_len, loc.block.length)
+                    if sum(round_bucket(r.loc.block.length)
+                           for r in cur_rows) >= wave_budget:
+                        seal()
+                seal()
+            else:
+                cur_bytes = sum(
+                    round_bucket(r.loc.block.length) for r in cur_rows
+                )
+                if cur_rows and cur_bytes + group_bytes > wave_budget:
+                    seal()
+                for loc in group:
+                    cur_rows.append(_Row(loc, loc.block.length // itemsize))
+                cur_max_len = max(cur_max_len, group_max)
+                # fusable iff every one of the pid's published blocks
+                # made it into the schedule (full device cover, the
+                # merged-cover rule of shuffle/merge.py) and they share
+                # this wave
+                if per_pid_eligible[pid] == per_pid_total[pid]:
+                    fusable.add(pid)
+            i = j
+        seal()
+
+        if schedule == "ring":
+            # lane-major wave order: one source lane in flight at a
+            # time, walking the ring — the flow-controlled schedule
+            waves.sort(key=lambda w: lanes.index(w.lane))
+        self._m_plan_ms.observe((time.perf_counter() - t0) * 1e3)
+        return CollectivePlan(
+            schedule, waves, passthrough, frozenset(fusable), len(eligible)
+        )
+
+    # ------------------------------------------------------------------
+    # execute
+    # ------------------------------------------------------------------
+    def execute(
+        self,
+        shuffle_id: int,
+        plan: CollectivePlan,
+        dtype=np.uint8,
+        fused: bool = False,
+    ) -> Tuple[List[CollectiveResult], List[PartitionLocation]]:
+        """Run the compiled schedule; returns ``(results, degraded)``.
+
+        ``degraded`` lists every scheduled block that missed (evicted
+        mid-stage, stale coordinates, mover failure) — the caller host-
+        fetches them; with fusion on, a miss also unfuses its partition
+        (the survivors land per block, the host fills the gap), so the
+        byte content of the stage is identical on every path."""
+        if not plan.waves:
+            return [], []
+        fused = bool(fused) and self._conf.collective_fused_merge
+        self._schedule_label = plan.schedule
+        reg = get_registry()
+        results: List[CollectiveResult] = []
+        degraded: List[PartitionLocation] = []
+        self._m_plans.inc()
+        span = (
+            self._tracer.span(
+                "shuffle.collective", shuffle_id=shuffle_id,
+                schedule=plan.schedule, waves=len(plan.waves),
+                blocks=plan.device_blocks,
+            )
+            if self._tracer is not None
+            else None
+        )
+        ctx = span if span is not None else _null_ctx()
+        with ctx:
+            # pids that lose a row to degradation must not fuse: the
+            # host path refills per block, so survivors stay per block
+            unfusable: set = set()
+            landed: List[Tuple[CollectiveWave, object, List[int], object]] = []
+            for wave in plan.waves:
+                out = self._run_wave(shuffle_id, wave, dtype, reg)
+                if out is None:
+                    # whole-wave mover failure: every row degrades
+                    for row in wave.rows:
+                        degraded.append(row.loc)
+                        unfusable.add(row.loc.partition_id)
+                    self._m_degrades.inc(len(wave.rows))
+                    self._m_plane_fallbacks.inc(len(wave.rows))
+                    continue
+                stacked_dev, dead, stacked_host = out
+                for i in dead:
+                    degraded.append(wave.rows[i].loc)
+                    unfusable.add(wave.rows[i].loc.partition_id)
+                if dead:
+                    self._m_degrades.inc(len(dead))
+                    self._m_plane_fallbacks.inc(len(dead))
+                landed.append((wave, stacked_dev, dead, stacked_host))
+
+            for wave, stacked_dev, dead, stacked_host in landed:
+                results.extend(self._adopt_wave(
+                    wave, stacked_dev, dtype,
+                    fused, plan.fusable_pids - unfusable,
+                    stacked_host=stacked_host,
+                ))
+        return results, degraded
+
+    # ------------------------------------------------------------------
+    def _program_key_seen(self, key) -> None:
+        with self._cache_lock:
+            if key in self._seen_programs:
+                self._m_cache_hits.inc()
+            else:
+                self._seen_programs.add(key)
+                self._m_compiles.inc()
+
+    def _run_wave(self, shuffle_id, wave: CollectiveWave, dtype, reg):
+        """Pin, assemble, and move one wave. Returns ``(stacked_dev,
+        dead_row_indices, stacked_host)`` or None on a whole-wave
+        mover failure; ``stacked_host`` is the host-side assembly the
+        emulated mover staged from (adoption compacts it with plain
+        numpy instead of the device gather when off TPU)."""
+        t0 = time.perf_counter()
+        itemsize = np.dtype(dtype).itemsize
+        rows_b = wave.rows_b
+        b_elems = wave.bucket_elems
+        stacked = np.zeros((rows_b, b_elems), dtype=dtype)
+        dead: List[int] = []
+        try:
+            with ExitStack() as pins:
+                for i, row in enumerate(wave.rows):
+                    blk = row.loc.block
+                    arena = visible_arena(row.loc.manager_id.executor_id)
+                    src = None
+                    if arena is not None:
+                        src = pins.enter_context(
+                            arena.pinned_if_resident(blk.arena_handle)
+                        )
+                    if (
+                        src is None
+                        or blk.arena_offset + blk.length > src.capacity
+                        or np.dtype(src.array.dtype) != np.dtype(dtype)
+                    ):
+                        row.live = False
+                        dead.append(i)
+                        continue
+                    # the emulated gather: source HBM -> host lane of
+                    # the assembled stack (the TPU path skips this and
+                    # DMAs source-side shards directly)
+                    host = np.asarray(src.array).view(dtype)
+                    off = blk.arena_offset // itemsize
+                    stacked[i, : row.elems] = host[off : off + row.elems]
+            if len(dead) == len(wave.rows):
+                # every row died at the pin: nothing to move; the
+                # caller degrades them all (tuple keeps the uniform
+                # "landed" return shape, distinct from mover failure)
+                return None, dead, None
+            key = ("wave", rows_b, b_elems, np.dtype(dtype).name)
+            self._program_key_seen(key)
+            stacked_dev = None
+            if remote_copy.is_tpu_mesh():
+                # batched-DMA kernel epoch: one compiled program per
+                # (rows class, bucket class, dtype), per-row source ids
+                # in the scalar-prefetch lane. Any bring-up surprise
+                # degrades to the transfer engine below — same bytes.
+                try:
+                    stacked_dev = self._pallas_wave(wave, stacked)
+                except Exception:
+                    logger.exception(
+                        "pallas wave mover failed; using transfer engine"
+                    )
+            if stacked_dev is None:
+                stacked_dev = remote_copy.emulated_wave_pull(
+                    stacked, self._dev.device
+                )
+        except Exception:
+            logger.exception("collective wave failed; degrading to host")
+            return None
+        live = len(wave.rows) - len(dead)
+        nbytes = sum(r.elems * itemsize for r in wave.rows if r.live)
+        self._m_blocks.inc(live)
+        self._m_bytes.inc(nbytes)
+        self._m_plane_pulls.inc(live)
+        self._m_plane_bytes.inc(nbytes)
+        reg.counter(
+            "collective.waves", role=self._executor_id,
+            schedule=self._schedule_label,
+        ).inc()
+        reg.histogram(
+            "collective.wave_ms", role=self._executor_id,
+            schedule=self._schedule_label,
+        ).observe((time.perf_counter() - t0) * 1e3)
+        return stacked_dev, dead, stacked
+
+    # conf-resolved schedule of the plan currently executing (execute()
+    # runs plans one at a time per endpoint; set before the wave loop)
+    _schedule_label = "ring"
+
+    def _pallas_wave(self, wave: CollectiveWave, stacked: np.ndarray):
+        """TPU mover: run the wave as one batched remote-DMA kernel
+        epoch (``ops/remote_copy._wave_pull_program``). The send-layout
+        shards carry the wave on every source device; the per-row id
+        lane names which peer's DMA lands each row. Returns the landed
+        [rows_b, bucket] stack committed to the local device, or raises
+        (caller falls back to the transfer engine)."""
+        import jax
+
+        n = remote_copy.mesh_device_count()
+        rows_b = wave.rows_b
+        ids = np.zeros((rows_b,), dtype=np.int32)
+        for i, row in enumerate(wave.rows):
+            ids[i] = max(0, row.loc.block.device_coords) % n
+        sharded = jax.device_put(np.tile(stacked, (n, 1)))
+        landed = remote_copy.pallas_wave_pull(ids, sharded)
+        return jax.device_put(
+            np.asarray(landed)[:rows_b], self._dev.device
+        )
+
+    def _adopt_wave(self, wave, stacked_dev, dtype, fused, fusable_pids,
+                    stacked_host=None):
+        """Slice a landed wave into arena slabs: fused partitions land
+        as one merged slab; everything else lands per block. Fused
+        compaction runs the cached device gather when the wave is TPU-
+        resident, and a plain numpy concatenate off-TPU (the emulated
+        mover assembled ``stacked_host`` anyway, and a device gather
+        program is pure overhead on the single-core harness)."""
+        itemsize = np.dtype(dtype).itemsize
+        out: List[CollectiveResult] = []
+        flat = None
+        starts_e = None
+        if fused:
+            # per-row element offsets (host-known lengths), feeding the
+            # cached compaction gather
+            counts = np.array(
+                [r.elems if r.live else 0 for r in wave.rows]
+                + [0] * (wave.rows_b - len(wave.rows)),
+                dtype=np.int32,
+            )
+            ends_e = np.cumsum(counts, dtype=np.int32)
+            starts_e = ends_e - counts
+            need = any(
+                r.live and r.loc.partition_id in fusable_pids
+                for r in wave.rows
+            )
+            if need and stacked_host is not None and (
+                not remote_copy.is_tpu_mesh()
+            ):
+                flat = np.concatenate(
+                    [stacked_host[i, : r.elems]
+                     for i, r in enumerate(wave.rows) if r.live]
+                    or [np.empty(0, dtype=dtype)]
+                )
+            elif need:
+                key = ("compact", wave.rows_b, wave.bucket_elems,
+                       np.dtype(dtype).name)
+                self._program_key_seen(key)
+                prog = _compaction_program(
+                    wave.rows_b, wave.bucket_elems, np.dtype(dtype).name
+                )
+                flat = prog(stacked_dev, starts_e, ends_e)
+
+        i = 0
+        n = len(wave.rows)
+        while i < n:
+            row = wave.rows[i]
+            pid = row.loc.partition_id
+            j = i
+            while j < n and wave.rows[j].loc.partition_id == pid:
+                j += 1
+            group = [r for r in wave.rows[i:j] if r.live]
+            if not group:
+                i = j
+                continue
+            if fused and flat is not None and pid in fusable_pids:
+                lo = int(starts_e[i])
+                hi = lo + sum(r.elems for r in group)
+                seg = flat[lo:hi]
+                if isinstance(seg, np.ndarray):
+                    # host-compacted: the merged slab moves in ONE put
+                    import jax
+
+                    seg = jax.device_put(seg, self._dev.device)
+                dev = self._dev.get(seg.size * itemsize)
+                try:
+                    dev = dev.put_array(seg)
+                except Exception:
+                    dev.free()
+                    raise
+                out.append(CollectiveResult(
+                    pid, dev, [r.loc for r in group], True
+                ))
+                self._m_fused.inc()
+            else:
+                for k, r in enumerate(wave.rows[i:j]):
+                    if not r.live:
+                        continue
+                    rowv = stacked_dev[i + k, : r.elems]
+                    dev = self._dev.get(r.elems * itemsize)
+                    try:
+                        dev = dev.put_array(rowv)
+                    except Exception:
+                        dev.free()
+                        raise
+                    out.append(CollectiveResult(pid, dev, [r.loc], False))
+            i = j
+        return out
+
+
+def _null_ctx():
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -35,6 +35,17 @@ Planner decision table (see DESIGN.md §17):
 Checksums are verified at publish time on the host copy; the device
 copy is the same staged bytes, so device pulls trust them (the host
 path keeps its per-block verify gate).
+
+Relationship to the whole-stage schedule compiler (DESIGN.md §22,
+shuffle/collective.py): when a reduce stage carries enough
+device-resident blocks, the compiler claims them up front and moves
+them in batched DMA waves; THIS planner then only sees the compiler's
+passthrough set (non-device blocks, sub-minimum blocks, stages below
+``collective.minBlocks``) plus any wave rows that degraded mid-stage —
+for those the decision table above applies unchanged. The plane's
+``pulls``/``bytes``/``fallbacks`` counters stay the single source of
+truth across both paths: the compiler feeds them for its landed and
+degraded rows.
 """
 
 from __future__ import annotations

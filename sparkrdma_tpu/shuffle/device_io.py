@@ -30,6 +30,7 @@ from sparkrdma_tpu.ops.hbm_arena import (
     DeviceBufferManager,
     _size_class,
 )
+from sparkrdma_tpu.shuffle.collective import ShuffleScheduleCompiler
 from sparkrdma_tpu.shuffle.device_fetch import (
     DeviceFetchPlane,
     DevicePulledBlock,
@@ -199,6 +200,13 @@ class DeviceShuffleIO:
         self._arena_published: Dict[int, List[DeviceBuffer]] = {}
         register_arena(manager.executor_id, self._dev)
         self._plane = DeviceFetchPlane(conf, self._dev, manager.executor_id)
+        # whole-stage schedule compiler (DESIGN.md §22): batches the
+        # stage's device-resident blocks into compiled DMA waves; the
+        # per-block plane above stays the path for its passthrough set
+        self._collective = ShuffleScheduleCompiler(
+            conf, self._dev, manager.executor_id,
+            tracer=getattr(manager, "tracer", None),
+        )
         self._lock = threading.Lock()
         # fetch-phase accounting (tunnel-vs-framework attribution):
         #   transport_s — waiting for bytes to ARRIVE in host memory
@@ -391,6 +399,7 @@ class DeviceShuffleIO:
         end_partition: int,
         dtype=np.uint8,
         timeout_s: Optional[float] = None,
+        fused: bool = False,
     ) -> Dict[int, List[DeviceBuffer]]:
         """Pull every block of ``[start, end)`` into HBM slabs.
 
@@ -414,7 +423,17 @@ class DeviceShuffleIO:
         Arrived buffers stage in COMPLETION order while
         slower reads are still in flight: staging (the expensive
         host->HBM transfer on this rig) overlaps the waiting instead of
-        serializing behind issue order."""
+        serializing behind issue order.
+
+        Device-resident blocks route through the whole-stage schedule
+        compiler (shuffle/collective.py, DESIGN.md §22): the host READs
+        for the non-device remainder are issued FIRST, then the
+        compiled DMA waves run while those reads are in flight. With
+        ``fused=True`` a partition fully covered by one wave lands as
+        ONE merged slab (its blocks concatenated in deterministic
+        source order) — callers opt in because it changes the result
+        shape; the ``collective.fusedMerge`` knob is the global
+        off-switch."""
         mgr = self._manager
         conf = mgr.conf
         if timeout_s is None:
@@ -446,6 +465,10 @@ class DeviceShuffleIO:
         out: Dict[int, List[DeviceBuffer]] = {}
         my_id = mgr.executor_id
         locations = self._apply_merged_plan(locations, my_id)
+        # whole-stage compile: device-resident blocks batch into DMA
+        # waves; everything the compiler declines comes back in
+        # cplan.passthrough and takes the per-block loop unchanged
+        cplan = self._collective.plan(locations, dtype)
         # Each in-flight read OWNS its destination buffer through its
         # completion listener: the buffer returns to the pool only once
         # the transport is provably done writing into it (completion or
@@ -458,15 +481,18 @@ class DeviceShuffleIO:
         arrivals: "queue.Queue[int]" = queue.Queue()
 
         try:
-            for loc in locations:
-                # device plane first: an arena-resident source pulls
-                # HBM->HBM and skips host transport AND staging; any
-                # planner refusal (spilled, too small, foreign arena,
-                # dtype) silently continues into the host path below
-                dev = self._plane.try_pull(loc, dtype)
-                if dev is not None:
-                    out.setdefault(loc.partition_id, []).append(dev)
-                    continue
+            def _issue(loc, allow_pull=True):
+                nonlocal t_stage, n_bytes
+                if allow_pull:
+                    # device plane: an arena-resident source pulls
+                    # HBM->HBM and skips host transport AND staging;
+                    # any planner refusal (spilled, too small, foreign
+                    # arena, dtype) silently continues into the host
+                    # path below
+                    dev = self._plane.try_pull(loc, dtype)
+                    if dev is not None:
+                        out.setdefault(loc.partition_id, []).append(dev)
+                        return
                 if loc.manager_id.executor_id == my_id:
                     # local short-circuit straight from the registered
                     # region — DMA'd directly, never copied to bytes.
@@ -486,7 +512,7 @@ class DeviceShuffleIO:
                     t_stage += time.perf_counter() - ts
                     n_bytes += loc.block.length
                     out.setdefault(loc.partition_id, []).append(dev)
-                    continue
+                    return
                 ch = mgr.get_channel_to(loc.manager_id, purpose="data")
                 if mapped_delivery_enabled(conf, ch):
                     pending.append(
@@ -497,6 +523,20 @@ class DeviceShuffleIO:
                     pending.append(
                         _start_read(mgr, arrivals, len(pending), loc, reg, ch)
                     )
+
+            for loc in cplan.passthrough:
+                _issue(loc)
+            # compiled waves run NOW, while the host READs issued above
+            # are in flight — DMA epochs overlap host-plane transport
+            results, degraded = self._collective.execute(
+                shuffle_id, cplan, dtype, fused=fused
+            )
+            for r in results:
+                out.setdefault(r.pid, []).append(r.dev)
+            # rows the waves lost (evicted mid-stage, mover surprise)
+            # re-issue through the host path: silent, byte-identical
+            for loc in degraded:
+                _issue(loc, allow_pull=False)
 
             remaining = {i for i, e in enumerate(pending) if e is not None}
             refetched: set = set()
@@ -676,16 +716,22 @@ class DeviceShuffleIO:
         out: Dict[int, List[HostBlock]] = {}
         my_id = mgr.executor_id
         locations = self._apply_merged_plan(locations, my_id)
+        # whole-stage compile, UNFUSED: the split-phase pipeline's
+        # verify/stage seams are per block, so every wave row comes
+        # back as its own DevicePulledBlock
+        cplan = self._collective.plan(locations, dtype)
         pending: List[Optional[Tuple]] = []
         arrivals: "queue.Queue[int]" = queue.Queue()
         try:
-            for loc in locations:
-                dev = self._plane.try_pull(loc, dtype)
-                if dev is not None:
-                    out.setdefault(loc.partition_id, []).append(
-                        DevicePulledBlock(shuffle_id, loc, dev)
-                    )
-                    continue
+            def _issue(loc, allow_pull=True):
+                nonlocal n_bytes
+                if allow_pull:
+                    dev = self._plane.try_pull(loc, dtype)
+                    if dev is not None:
+                        out.setdefault(loc.partition_id, []).append(
+                            DevicePulledBlock(shuffle_id, loc, dev)
+                        )
+                        return
                 if loc.manager_id.executor_id == my_id:
                     # local short-circuit: the handle aliases the
                     # publisher's registered span directly (released by
@@ -701,7 +747,7 @@ class DeviceShuffleIO:
                     out.setdefault(loc.partition_id, []).append(
                         HostBlock(shuffle_id, loc, view, "local", None)
                     )
-                    continue
+                    return
                 ch = mgr.get_channel_to(loc.manager_id, purpose="data")
                 if mapped_delivery_enabled(conf, ch):
                     pending.append(
@@ -712,6 +758,19 @@ class DeviceShuffleIO:
                     pending.append(
                         _start_read(mgr, arrivals, len(pending), loc, reg, ch)
                     )
+
+            for loc in cplan.passthrough:
+                _issue(loc)
+            # waves overlap the in-flight host READs issued above
+            results, degraded = self._collective.execute(
+                shuffle_id, cplan, dtype, fused=False
+            )
+            for r in results:
+                out.setdefault(r.pid, []).append(
+                    DevicePulledBlock(shuffle_id, r.locs[0], r.dev)
+                )
+            for loc in degraded:
+                _issue(loc, allow_pull=False)
 
             remaining = {i for i in range(len(pending))}
             while remaining:

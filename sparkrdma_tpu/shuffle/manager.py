@@ -455,8 +455,12 @@ class TpuShuffleManager:
             if self.telemetry is not None and msg.partition_id < 0:
                 for loc in msg.locations:
                     if not loc.block.merged_cover:
+                        # source executor = the DMA lane this block will
+                        # pull over (collective schedule lane balancing)
                         self.telemetry.record_partition_bytes(
-                            msg.shuffle_id, loc.partition_id, loc.block.length
+                            msg.shuffle_id, loc.partition_id,
+                            loc.block.length,
+                            source=loc.manager_id.executor_id,
                         )
             for fetch in to_reply:
                 self._reply_fetch(fetch)
@@ -857,6 +861,16 @@ class TpuShuffleManager:
                         if not loc.block.merged_cover
                     )
         return out
+
+    def partition_lane_sizes(self, shuffle_id: int) -> Dict[str, Dict[int, int]]:
+        """Driver: the same byte totals split by SOURCE executor
+        (source -> pid -> bytes) — the planner's DMA-lane signal for
+        lane-balanced reduce cuts (shuffle/planner.py). Telemetry-fed;
+        empty when no telemetry hub runs (static/total-bytes planning
+        proceeds unchanged)."""
+        if self.telemetry is not None:
+            return self.telemetry.partition_lane_bytes(shuffle_id)
+        return {}
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         if self.merge_endpoint is not None:

@@ -65,7 +65,8 @@ class AdaptivePartitioner:
 
     # ------------------------------------------------------------------
     def plan(
-        self, sizes: Sequence[int], num_reducers: int
+        self, sizes: Sequence[int], num_reducers: int,
+        lane_sizes: Dict[str, Sequence[int]] = None,
     ) -> List[Tuple[int, int]]:
         """Contiguous ``(lo, hi)`` ranges covering ``[0, P)``, at most
         ``num_reducers`` of them, byte-balanced against ``sizes``.
@@ -74,8 +75,19 @@ class AdaptivePartitioner:
         (remaining_bytes / remaining_ranges) so early over-full ranges
         don't starve the tail, plus hot-partition isolation: a
         partition whose size is >= hot_factor * ideal gets its own
-        range when possible (cut before it and after it)."""
+        range when possible (cut before it and after it).
+
+        ``lane_sizes`` (source executor -> per-partition bytes) switches
+        the cost function from byte totals to DMA-LANE cost: the
+        collective schedule's wave wall is set by its hottest source
+        lane, not the byte sum, so a partition fed overwhelmingly by one
+        source costs ``num_lanes * max_lane_bytes`` even when its total
+        looks benign. Cuts then balance lane occupancy across reducers
+        (the whole-stage schedule compiler's wave planner, DESIGN.md
+        §22)."""
         t0 = time.perf_counter()
+        if lane_sizes:
+            sizes = self._lane_costs(sizes, lane_sizes)
         p = len(sizes)
         n = max(1, int(num_reducers))
         if p == 0:
@@ -144,6 +156,29 @@ class AdaptivePartitioner:
             max(sum(sizes[a:b]) for a, b in ranges) / ideal if ideal else 0.0,
         )
         return ranges
+
+    # ------------------------------------------------------------------
+    def _lane_costs(
+        self, sizes: Sequence[int], lane_sizes: Dict[str, Sequence[int]]
+    ) -> List[int]:
+        """Per-partition DMA-lane cost: ``max(total, L * hottest_lane)``.
+
+        A ring-scheduled wave moves one source lane at a time, so a
+        partition's fetch wall is its hottest lane times the lane
+        count when one source dominates — and never better than its
+        byte total when sources are balanced (then the two coincide)."""
+        lanes = [list(v) for v in lane_sizes.values() if v]
+        if not lanes:
+            return list(sizes)
+        n_lanes = len(lanes)
+        costs: List[int] = []
+        for pid in range(len(sizes)):
+            hottest = max(
+                (lane[pid] if pid < len(lane) else 0) for lane in lanes
+            )
+            costs.append(max(sizes[pid], n_lanes * hottest))
+        get_registry().counter("collective.lane_plans", role="driver").inc()
+        return costs
 
     # ------------------------------------------------------------------
     def plan_weights(self, sizes: Dict[int, int]) -> List[int]:

@@ -118,6 +118,12 @@ DECLARED_KNOBS: Dict[str, str] = {
     "hbm.spillDir": "disk-tier spill directory",
     "deviceFetch.enabled": "HBM->HBM device fetch plane",
     "deviceFetch.minBlockBytes": "device-plane minimum block size",
+    "collective.enabled": "whole-stage collective shuffle compiler",
+    "collective.minBlocks": "device blocks needed to engage the compiler",
+    "collective.schedule": "collective schedule: auto|ring|a2a",
+    "collective.waveBytes": "max payload bytes per DMA wave",
+    "collective.fusedMerge": "allow fetch+merge fusion in one epoch",
+    "collective.laneBalance": "planner balances DMA lanes, not just bytes",
     "tenancy.enabled": "multi-tenant serving layer",
     "tenancy.maxConcurrentJobs": "admission in-flight job cap",
     "tenancy.admitTimeoutMs": "admission queue deadline",
@@ -659,6 +665,58 @@ class TpuShuffleConf:
         dispatch overhead beats the HBM bandwidth win on tiny blocks,
         and small blocks churn arena slabs (min slab class 16 KiB)."""
         return self._bytes("deviceFetch.minBlockBytes", "16k", 0, 1 << 33)
+
+    @property
+    def collective_enabled(self) -> bool:
+        """Whole-stage collective shuffle (shuffle/collective.py):
+        compile a reduce stage's device-resident location set into
+        batched DMA waves instead of per-block planner pulls. Device
+        blocks the compiler cannot place (too few, wrong dtype, evicted
+        mid-stage) silently degrade to the per-block planner or the
+        host triple — results are byte-identical either way."""
+        return self._bool("collective.enabled", True)
+
+    @property
+    def collective_min_blocks(self) -> int:
+        """Device-resident blocks a stage must publish before the
+        compiler engages; below this the per-block planner wins (a
+        one-block "wave" is pure dispatch overhead)."""
+        return self._int("collective.minBlocks", 2, 1, 1 << 20)
+
+    @property
+    def collective_schedule(self) -> str:
+        """Wave schedule: ``ring`` orders waves lane-major around the
+        source ring (one lane in flight — the flow-controlled
+        schedule), ``a2a`` interleaves lanes round-robin (dense
+        all-to-all), ``auto`` picks a2a when the stage spans more than
+        two source lanes."""
+        raw = (self.get(PREFIX + "collective.schedule", "auto") or "auto").lower()
+        return raw if raw in ("auto", "ring", "a2a") else "auto"
+
+    @property
+    def collective_wave_bytes(self) -> int:
+        """Payload cap per DMA wave — the device plane's
+        maxBytesInFlight analogue: bounds the stacked landing buffer
+        and keeps one slow wave from serializing the whole stage."""
+        return self._bytes("collective.waveBytes", "64m", 1 << 16, 1 << 33)
+
+    @property
+    def collective_fused_merge(self) -> bool:
+        """Allow fetch->merge fusion: a partition whose every block
+        arrives in one wave lands as ONE merged slab (concatenated in
+        deterministic source order) with no intermediate HBM round
+        trip. Fusion changes the *shape* of the result (one buffer per
+        partition instead of per block), so callers opt in per fetch;
+        this knob is the global off-switch."""
+        return self._bool("collective.fusedMerge", True)
+
+    @property
+    def collective_lane_balance(self) -> bool:
+        """Adaptive planner balances per-lane (source executor) DMA
+        bytes, not just totals: a partition concentrated in one lane
+        costs a longer DMA epoch than the same bytes spread across
+        lanes, so reduce-range cuts weigh the max lane load."""
+        return self._bool("collective.laneBalance", True)
 
     @property
     def hbm_spill_dir(self) -> str:

@@ -1,0 +1,399 @@
+"""Whole-stage collective shuffle (DESIGN.md §22): schedule selection,
+compiled-vs-per-block byte identity, fetch+merge fusion, mid-stage
+degrade, and lane-balanced reduce cuts — all on the emulated
+``JAX_PLATFORMS=cpu`` topology tier-1 runs on."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.locations import (
+    BlockLocation,
+    PartitionLocation,
+    ShuffleManagerId,
+)
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+BLOCK = 64 << 10  # above the 16 KiB deviceFetch.minBlockBytes default
+
+
+def _loc(pid, length, exec_id, mkey=1, handle=1, coords=0):
+    return PartitionLocation(
+        ShuffleManagerId("host", 1234, exec_id),
+        pid,
+        BlockLocation(
+            0, length, mkey, device_coords=coords, arena_handle=handle
+        ),
+    )
+
+
+def _counter(name, role):
+    return get_registry().counter(name, role=role)
+
+
+@pytest.fixture()
+def cluster():
+    from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
+
+    conf = TpuShuffleConf({"tpu.shuffle.transport": "python"})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex_map = TpuShuffleManager(conf, is_driver=False, executor_id="cs-map")
+    ex_red = TpuShuffleManager(conf, is_driver=False, executor_id="cs-red")
+    driver.register_shuffle(
+        BaseShuffleHandle(
+            shuffle_id=91, num_maps=1, partitioner=HashPartitioner(3)
+        )
+    )
+    io_map, io_red = DeviceShuffleIO(ex_map), DeviceShuffleIO(ex_red)
+    try:
+        yield conf, io_map, io_red
+    finally:
+        io_red.stop()
+        io_map.stop()
+        ex_red.stop()
+        ex_map.stop()
+        driver.stop()
+
+
+def _publish_shards(io_map, shards=3, seed=57):
+    """``shards`` map windows, 3 partitions each -> 3 blocks per pid,
+    all from one publisher (one DMA lane)."""
+    rng = np.random.default_rng(seed)
+    windows, all_data = [], {}
+    for _ in range(shards):
+        data = {p: rng.integers(0, 256, BLOCK + p, np.uint8) for p in range(3)}
+        windows.append(io_map.stage_device_blocks(91, data))
+        for p, arr in data.items():
+            all_data.setdefault(p, []).append(arr)
+    io_map.publish_staged_batch(91, windows, num_map_outputs_each=1)
+    return all_data
+
+
+# ----------------------------------------------------------------------
+# schedule compilation (plan-level, synthetic location sets)
+# ----------------------------------------------------------------------
+def test_schedule_selection_and_passthrough(cluster):
+    """auto resolves ring for <=2 source lanes and a2a above; explicit
+    knob wins; sub-minBlocks stages and disabled compilers pass every
+    location through untouched."""
+    from sparkrdma_tpu.shuffle import device_fetch as df
+    from sparkrdma_tpu.shuffle.collective import ShuffleScheduleCompiler
+
+    conf, io_map, io_red = cluster
+    for i in range(3):
+        df.register_arena(f"cs-lane-{i}", io_map.device_buffers)
+    try:
+        comp = ShuffleScheduleCompiler(
+            conf, io_red.device_buffers, "cs-sched"
+        )
+        three_lanes = [
+            _loc(p, BLOCK, f"cs-lane-{p}", mkey=10 + p) for p in range(3)
+        ]
+        plan = comp.plan(three_lanes)
+        assert plan.schedule == "a2a"
+        assert plan.waves and not plan.passthrough
+        assert plan.device_blocks == 3
+
+        two_lanes = [
+            _loc(p, BLOCK, f"cs-lane-{p % 2}", mkey=20 + p) for p in range(3)
+        ]
+        assert comp.plan(two_lanes).schedule == "ring"
+
+        conf.set("tpu.shuffle.collective.schedule", "ring")
+        try:
+            assert comp.plan(three_lanes).schedule == "ring"
+        finally:
+            conf.set("tpu.shuffle.collective.schedule", "auto")
+
+        # below minBlocks: the per-block planner keeps the whole stage
+        solo = comp.plan([_loc(0, BLOCK, "cs-lane-0")])
+        assert not solo.waves and len(solo.passthrough) == 1
+
+        # a location with no device extension never schedules
+        mixed = three_lanes + [_loc(9, BLOCK, "cs-lane-0", handle=0)]
+        plan = comp.plan(mixed)
+        assert len(plan.passthrough) == 1
+        assert plan.passthrough[0].partition_id == 9
+
+        conf.set("tpu.shuffle.collective.enabled", "false")
+        try:
+            off = comp.plan(three_lanes)
+            assert not off.waves and len(off.passthrough) == 3
+        finally:
+            conf.set("tpu.shuffle.collective.enabled", "true")
+    finally:
+        for i in range(3):
+            df.unregister_arena(f"cs-lane-{i}", io_map.device_buffers)
+
+
+def test_wave_formation_buckets_and_pid_grouping(cluster):
+    """Waves cut at partition boundaries under waveBytes, with both
+    axes power-of-two bucketed so ragged stages share program shapes."""
+    from sparkrdma_tpu.ops.exchange import round_bucket, round_rows
+    from sparkrdma_tpu.shuffle import device_fetch as df
+    from sparkrdma_tpu.shuffle.collective import ShuffleScheduleCompiler
+
+    conf, io_map, io_red = cluster
+    df.register_arena("cs-lane-w", io_map.device_buffers)
+    try:
+        comp = ShuffleScheduleCompiler(conf, io_red.device_buffers, "cs-wf")
+        # ragged lengths across 3 pids, 2 blocks each
+        locs = [
+            _loc(p, BLOCK + 1000 * k, "cs-lane-w", mkey=30 + 2 * p + k)
+            for p in range(3)
+            for k in range(2)
+        ]
+        plan = comp.plan(locs)
+        assert plan.fusable_pids == frozenset({0, 1, 2})
+        (wave,) = plan.waves
+        assert wave.rows_b == round_rows(6)
+        longest = max(loc.block.length for loc in locs)
+        assert wave.bucket_elems == round_bucket(longest)
+        # pid groups are contiguous in the wave (fusion precondition)
+        pids = [r.loc.partition_id for r in wave.rows]
+        assert pids == sorted(pids)
+
+        # a tight wave budget splits at pid boundaries
+        conf.set("tpu.shuffle.collective.waveBytes", "192k")
+        try:
+            plan = comp.plan(locs)
+            assert len(plan.waves) > 1
+            for w in plan.waves:
+                assert [r.loc.partition_id for r in w.rows] == sorted(
+                    r.loc.partition_id for r in w.rows
+                )
+        finally:
+            conf.set("tpu.shuffle.collective.waveBytes", "64m")
+    finally:
+        df.unregister_arena("cs-lane-w", io_map.device_buffers)
+
+
+# ----------------------------------------------------------------------
+# execution byte identity (in-process cluster)
+# ----------------------------------------------------------------------
+def test_collective_vs_per_block_vs_host_byte_identity(cluster):
+    """The same stage fetched three ways — compiled collective,
+    per-block device pulls, host triple — lands byte-identical block
+    multisets, and the collective counters prove which path ran."""
+    conf, io_map, io_red = cluster
+    data = _publish_shards(io_map)
+    plans = _counter("collective.plans", "cs-red")
+    blocks = _counter("collective.blocks", "cs-red")
+    p0, b0 = plans.value, blocks.value
+
+    def fetch_multiset():
+        got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+        try:
+            return {
+                p: sorted(bytes(b.read(0, b.length)) for b in got[p])
+                for p in range(3)
+            }
+        finally:
+            for bufs in got.values():
+                for b in bufs:
+                    b.free()
+
+    via_collective = fetch_multiset()
+    assert plans.value - p0 == 1, "compiler did not engage"
+    assert blocks.value - b0 == 9, "not every block rode a wave"
+
+    conf.set("tpu.shuffle.collective.enabled", "false")
+    via_per_block = fetch_multiset()
+    assert plans.value - p0 == 1, "disabled compiler still planned"
+
+    conf.set("tpu.shuffle.deviceFetch.enabled", "false")
+    via_host = fetch_multiset()
+
+    want = {p: sorted(a.tobytes() for a in data[p]) for p in range(3)}
+    assert via_collective == want
+    assert via_per_block == want
+    assert via_host == want
+
+
+def test_fused_merge_matches_host_triple(cluster):
+    """fused=True lands ONE merged slab per fully-covered partition,
+    equal to the unfused wave rows concatenated in merge order — and
+    the underlying block multiset matches the host triple exactly."""
+    conf, io_map, io_red = cluster
+    data = _publish_shards(io_map, seed=61)
+    fused_c = _counter("collective.fused_merges", "cs-red")
+    f0 = fused_c.value
+
+    unfused = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+    try:
+        # wave-row order IS the deterministic merge order
+        expect = {
+            p: b"".join(bytes(b.read(0, b.length)) for b in unfused[p])
+            for p in range(3)
+        }
+        multiset = {
+            p: sorted(bytes(b.read(0, b.length)) for b in unfused[p])
+            for p in range(3)
+        }
+    finally:
+        for bufs in unfused.values():
+            for b in bufs:
+                b.free()
+
+    fused = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30, fused=True)
+    try:
+        for p in range(3):
+            assert len(fused[p]) == 1, "fusion must land one slab per pid"
+            assert bytes(fused[p][0].read(0, fused[p][0].length)) == expect[p]
+    finally:
+        for bufs in fused.values():
+            for b in bufs:
+                b.free()
+    assert fused_c.value - f0 == 3
+
+    # the fused content is the host triple's blocks, concatenated
+    conf.set("tpu.shuffle.deviceFetch.enabled", "false")
+    host = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+    try:
+        for p in range(3):
+            host_set = sorted(bytes(b.read(0, b.length)) for b in host[p])
+            assert host_set == multiset[p]
+            assert host_set == sorted(a.tobytes() for a in data[p])
+    finally:
+        for bufs in host.values():
+            for b in bufs:
+                b.free()
+
+    # global off-switch: fused=True silently returns per-block shape
+    conf.set("tpu.shuffle.deviceFetch.enabled", "true")
+    conf.set("tpu.shuffle.collective.fusedMerge", "false")
+    try:
+        got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30, fused=True)
+        try:
+            assert all(len(got[p]) == 3 for p in range(3))
+        finally:
+            for bufs in got.values():
+                for b in bufs:
+                    b.free()
+    finally:
+        conf.set("tpu.shuffle.collective.fusedMerge", "true")
+
+
+def test_eviction_mid_stage_degrades_silently(cluster):
+    """A slab evicted between plan and pin degrades its row to the
+    host triple — zero errors, byte-identical output, degrade counted,
+    and (under fusion) only ITS partition unfuses."""
+    conf, io_map, io_red = cluster
+    data = _publish_shards(io_map, seed=67)
+    degrades = _counter("collective.degrades", "cs-red")
+    d0 = degrades.value
+
+    # evict ONE of partition 1's three slabs (window 0 stages pids
+    # 0,1,2 in order, so flat index 1 is w0/p1)
+    victim = io_map._arena_published[91][1]
+    victim.spill_to_host()
+    assert victim.spilled
+
+    got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30, fused=True)
+    try:
+        assert len(got[0]) == 1 and len(got[2]) == 1, "other pids stay fused"
+        assert len(got[1]) == 3, "degraded pid must unfuse"
+        # fused pids carry all their blocks (order is the merge order;
+        # membership + total length pin the content)
+        for p in (0, 2):
+            blob = bytes(got[p][0].read(0, got[p][0].length))
+            assert len(blob) == sum(len(a) for a in data[p])
+            for a in data[p]:
+                assert a.tobytes() in blob
+        have1 = sorted(bytes(b.read(0, b.length)) for b in got[1])
+        assert have1 == sorted(a.tobytes() for a in data[1])
+    finally:
+        for bufs in got.values():
+            for b in bufs:
+                b.free()
+    assert degrades.value - d0 == 1, "exactly the evicted row degrades"
+
+
+def test_whole_stage_eviction_falls_back_to_host(cluster):
+    """Every scheduled slab evicted: the stage still completes byte-
+    exact through the host triple with one degrade per block."""
+    conf, io_map, io_red = cluster
+    data = _publish_shards(io_map, seed=71, shards=1)
+    degrades = _counter("collective.degrades", "cs-red")
+    d0 = degrades.value
+    for abuf in io_map._arena_published[91]:
+        abuf.spill_to_host()
+    got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+    try:
+        for p in range(3):
+            assert bytes(got[p][0].read(0, len(data[p][0]))) == (
+                data[p][0].tobytes()
+            )
+    finally:
+        for bufs in got.values():
+            for b in bufs:
+                b.free()
+    assert degrades.value - d0 == 3
+
+
+def test_split_phase_collective_pull(cluster):
+    """fetch_host_blocks routes wave rows back as DevicePulledBlock
+    entries (always unfused — the pipeline's seams are per block) that
+    flow through verify/stage untouched."""
+    from sparkrdma_tpu.shuffle.device_fetch import DevicePulledBlock
+
+    conf, io_map, io_red = cluster
+    data = _publish_shards(io_map, seed=73, shards=1)
+    blocks = _counter("collective.blocks", "cs-red")
+    b0 = blocks.value
+    got = io_red.fetch_host_blocks(91, 0, 3, timeout_s=30)
+    staged = {}
+    for p, hbs in got.items():
+        out = []
+        for hb in hbs:
+            assert isinstance(hb, DevicePulledBlock)
+            out.append(io_red.stage_host_block(io_red.verify_host_block(hb)))
+        staged[p] = out
+    assert blocks.value - b0 == 3
+    try:
+        for p in range(3):
+            assert bytes(staged[p][0].read(0, len(data[p][0]))) == (
+                data[p][0].tobytes()
+            )
+    finally:
+        for bufs in staged.values():
+            for b in bufs:
+                b.free()
+
+
+# ----------------------------------------------------------------------
+# lane-balanced reduce cuts (planner-level)
+# ----------------------------------------------------------------------
+def test_planner_lane_balanced_cuts():
+    """Equal byte totals hide a one-lane hotspot; the lane-aware cost
+    (num_lanes * hottest lane) re-cuts the ranges around it while the
+    totals-only plan stays static."""
+    from sparkrdma_tpu.shuffle.planner import AdaptivePartitioner
+
+    conf = TpuShuffleConf()
+    p, n = 8, 4
+    sizes = [100] * p
+    lane_sizes = {src: [25] * p for src in ("la", "lb", "lc", "ld")}
+    for src in ("lb", "lc", "ld"):
+        lane_sizes[src][5] = 0
+    lane_sizes["la"][5] = 100  # same total, one lane carries it all
+
+    lane_plans = get_registry().counter("collective.lane_plans", role="driver")
+    c0 = lane_plans.value
+    ap = AdaptivePartitioner(conf)
+    base = ap.plan(sizes, n)
+    assert base == [(0, 2), (2, 4), (4, 6), (6, 8)], "uniform stays static"
+    laned = ap.plan(sizes, n, lane_sizes=lane_sizes)
+    assert lane_plans.value - c0 == 1
+    assert laned != base, "lane hotspot must move the cuts"
+    # structural safety: contiguous cover of [0, p), at most n ranges
+    assert len(laned) <= n
+    assert laned[0][0] == 0 and laned[-1][1] == p
+    for (a, b), (c, d) in zip(laned, laned[1:]):
+        assert b == c
+
+    # balanced lanes change nothing
+    even = {src: [25] * p for src in ("la", "lb", "lc", "ld")}
+    assert ap.plan(sizes, n, lane_sizes=even) == base

@@ -14,6 +14,7 @@ from sparkrdma_tpu.ops.exchange import (
     ExchangeProgram,
     pack_blocks,
     round_bucket,
+    round_rows,
     unpack_blocks,
 )
 from sparkrdma_tpu.parallel.mesh import make_mesh
@@ -130,3 +131,49 @@ def test_exchange_on_2d_mesh():
         assert unpack_blocks(recv[dst], rcounts[dst]) == [
             _payload(src, dst) for src in range(e)
         ]
+
+
+def test_round_rows_power_of_two():
+    assert round_rows(1) == 1
+    assert round_rows(3) == 4
+    assert round_rows(4) == 4
+    assert round_rows(5) == 8
+
+
+def _build_global_send_multi(e: int, block: int, rpp: int):
+    """Like _build_global_send but with ``rpp`` rows per (src, dst)
+    pair, tagged so every row is distinguishable after the exchange."""
+    rows, counts = [], []
+    for src in range(e):
+        blocks = [
+            _payload(src, dst) + bytes([k])
+            for dst in range(e)
+            for k in range(rpp)
+        ]
+        slab, cnt = pack_blocks(blocks, block)
+        rows.append(slab)
+        counts.append(cnt)
+    return np.concatenate(rows, axis=0), np.concatenate(counts, axis=0)
+
+
+def test_exchange_row_bucketing_shares_programs():
+    """Ragged row counts bucket to the same power-of-two program: a
+    3-rows-per-peer stage pads to 4 and reuses the 4-rows-per-peer
+    compilation, byte-exact after the pad rows are stripped."""
+    mesh = make_mesh()
+    prog = ExchangeProgram(mesh)
+    e = prog.num_shards
+    block = 512
+    for rpp in (3, 4):
+        send, counts = _build_global_send_multi(e, block, rpp)
+        recv, rcounts = prog.exchange(send, counts)
+        recv = np.asarray(recv).reshape(e, e, rpp, block)
+        rcounts = np.asarray(rcounts).reshape(e, e, rpp)
+        for dst in range(e):
+            for src in range(e):
+                assert unpack_blocks(recv[dst, src], rcounts[dst, src]) == [
+                    _payload(src, dst) + bytes([k]) for k in range(rpp)
+                ], f"rpp={rpp} src={src} dst={dst}"
+    # both stages compiled into ONE cached program (rows bucketed 3->4)
+    assert len(prog._all_to_all_cache) == 1
+    assert round_rows(3) == round_rows(4) == 4
