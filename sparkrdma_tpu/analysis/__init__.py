@@ -5,8 +5,10 @@ invariants that ordinary linters cannot see: every ``tpu.shuffle.*``
 knob read must resolve against the declared-knobs table in
 ``utils/config.py``; every metrics-registry instrument must belong to
 a declared family with a consistent label set and an OBSERVABILITY.md
-anchor; the wire-extension markers (0xFFFF/0xFFFE/0xFFFD) and their
-struct formats must agree between encoder and parser; and thread
+anchor; the wire-extension markers (0xFFFF/0xFFFE/0xFFFD/0xFFFC) and
+their struct formats must agree between encoder and parser, with every
+marker dispatched from the parser's single peek loop so extensions and
+the trace trailer parse in ANY order; and thread
 spawns on tenancy-sensitive paths must re-enter ``tenant_scope``.
 This package encodes each invariant as an AST pass over the tree and
 exposes them behind ``python -m sparkrdma_tpu.analysis`` (gated in
@@ -29,7 +31,7 @@ import ast
 import dataclasses
 import re
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "Finding",
@@ -76,6 +78,9 @@ class SourceFile:
         self.lines = source.splitlines()
         # line -> set of suppressed pass ids ("all" suppresses any)
         self.suppressions: Dict[int, Set[str]] = {}
+        #: well-formed suppressions as (line, pass_ids, reason) — the
+        #: ``--audit-ignores`` inventory
+        self.suppression_records: List[Tuple[int, Set[str], str]] = []
         #: malformed suppressions (missing reason) found while parsing
         self.bad_suppressions: List[Finding] = []
         self._scan_suppressions()
@@ -107,6 +112,8 @@ class SourceFile:
                     )
                 )
                 ids -= unknown
+            if ids:
+                self.suppression_records.append((i, set(ids), m.group(2)))
             # a comment-only line suppresses the NEXT line too
             target_lines = [i]
             if text.lstrip().startswith("#"):

@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list", action="store_true", help="list pass ids and exit",
     )
+    ap.add_argument(
+        "--audit-ignores", action="store_true",
+        help="list every '# analysis: ignore' suppression with its "
+        "reason and exit (malformed suppressions still fail the run)",
+    )
     args = ap.parse_args(argv)
 
     if args.list:
@@ -44,6 +49,23 @@ def main(argv=None) -> int:
 
     root = args.root or repo_root()
     files = load_tree(root)
+    if args.audit_ignores:
+        total = 0
+        for sf in files:
+            for line, ids, reason in sf.suppression_records:
+                total += 1
+                print(
+                    f"{sf.path}:{line}: "
+                    f"ignore[{','.join(sorted(ids))}] — {reason}"
+                )
+        bad = [f for sf in files for f in sf.bad_suppressions]
+        for f in bad:
+            print(f.render())
+        print(
+            f"\naudit: {total} suppression(s), {len(bad)} malformed",
+            file=sys.stderr,
+        )
+        return 1 if bad else 0
     if args.dump_metrics:
         from sparkrdma_tpu.analysis import metrics_pass
 

@@ -37,6 +37,8 @@ import time
 import traceback
 from typing import Dict, List, Optional, Set, Tuple
 
+from sparkrdma_tpu.analysis.modelcheck import sched as _sched
+
 __all__ = ["LockOrderDetector", "OrderedLock", "named_lock", "default"]
 
 
@@ -60,7 +62,7 @@ class LockOrderDetector:
         return h
 
     def held_names(self) -> List[str]:
-        return [l.name for l in self._held()]
+        return [loc.name for loc in self._held()]
 
     # -- lifecycle --------------------------------------------------------
     def enable(self) -> None:
@@ -180,15 +182,27 @@ class OrderedLock:
         self._lock = threading.RLock() if recursive else threading.Lock()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # model-checker seam (analysis/modelcheck/sched.py): one module
+        # attr-load + branch when no scheduler is active, mirroring the
+        # detector's enabled flag. Non-blocking try-locks never park.
+        sim = _sched.active
+        if sim is not None and blocking:
+            sim.before_lock_acquire(self)
         ok = self._lock.acquire(blocking, timeout)
-        if ok and self._det.enabled:
-            self._det.on_acquire(self)
+        if ok:
+            if self._det.enabled:
+                self._det.on_acquire(self)
+            if sim is not None:
+                sim.after_lock_acquire(self)
         return ok
 
     def release(self) -> None:
         if self._det.enabled:
             self._det.on_release(self)
         self._lock.release()
+        sim = _sched.active
+        if sim is not None:
+            sim.after_lock_release(self)
 
     def locked(self) -> bool:
         return self._lock.locked()

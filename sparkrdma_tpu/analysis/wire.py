@@ -1,9 +1,10 @@
 """wire-markers pass — extension markers/structs agree across codec.
 
 The trailing-extension scheme in ``rpc.py`` (checksum 0xFFFF, device
-0xFFFE, merged 0xFFFD) stays legacy-compatible only while a set of
-hand-maintained invariants hold. This pass re-derives them from the
-AST of any class that declares ``_<X>_MARKER`` attributes:
+0xFFFE, merged 0xFFFD, elastic 0xFFFC) stays legacy-compatible only
+while a set of hand-maintained invariants hold. This pass re-derives
+them from the AST of any class that declares ``_<X>_MARKER``
+attributes:
 
 - markers are integer literals, pairwise distinct, and >= 0xFF00 (the
   disambiguation against host-count words relies on markers being
@@ -17,7 +18,13 @@ AST of any class that declares ``_<X>_MARKER`` attributes:
   only is a silent wire break,
 - a ``_TRACE_EXT`` trailer, when present, must pack strictly fewer
   bytes than the minimum serialized PartitionLocation (28): the parser
-  tells "trailing trace ext" from "one more location" by size alone.
+  tells "trailing trace ext" from "one more location" by size alone,
+- FULL ORDERING: every marker must be dispatched from ONE ``while``
+  peek loop in the parser, each marker branch must end in ``continue``
+  (re-peek — extensions decode in any on-wire order, including orders
+  an older encoder never emits), and when a ``_TRACE_EXT`` trailer
+  exists the loop guard must reference it so the trace tail survives
+  any number of preceding extensions.
 
 Any ``struct.Struct`` class attribute in ``rpc.py``/``locations.py``
 that is used by an encoder method but not a parser method (or vice
@@ -58,7 +65,7 @@ def _struct_fmt(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _names_used(fn: ast.FunctionDef) -> set:
+def _names_used(fn: ast.AST) -> set:
     used = set()
     for n in ast.walk(fn):
         if isinstance(n, ast.Attribute):
@@ -66,6 +73,63 @@ def _names_used(fn: ast.FunctionDef) -> set:
         elif isinstance(n, ast.Name):
             used.add(n.id)
     return used
+
+
+def _ordering_findings(
+    sf: SourceFile,
+    markers: Dict[str, ast.Assign],
+    structs: Dict[str, str],
+    parsers: List[ast.FunctionDef],
+) -> List[Finding]:
+    """The any-order invariant: one peek loop dispatches every marker,
+    every marker branch re-peeks via ``continue``, and the loop guard
+    keeps the trace trailer reachable."""
+    findings: List[Finding] = []
+    marker_attrs = {f"_{x}_MARKER": x for x in markers}
+    whiles = [
+        n for p in parsers for n in ast.walk(p) if isinstance(n, ast.While)
+    ]
+    loop = None
+    for w in whiles:
+        if set(marker_attrs) <= _names_used(w):
+            loop = w
+            break
+    if loop is None:
+        for x, stmt in sorted(markers.items()):
+            findings.append(
+                Finding(
+                    PASS_ID, sf.path, stmt.lineno,
+                    f"no single parser peek loop dispatches _{x}_MARKER "
+                    "alongside the other markers — extension parse order "
+                    "is fixed, not any-order",
+                )
+            )
+        return findings
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.If):
+            continue
+        hit = sorted(_names_used(node.test) & set(marker_attrs))
+        if not hit or not node.body:
+            continue
+        if not isinstance(node.body[-1], ast.Continue):
+            findings.append(
+                Finding(
+                    PASS_ID, sf.path, node.lineno,
+                    f"marker branch for {'/'.join(hit)} does not end in "
+                    "'continue' — the loop stops re-peeking and any "
+                    "extension after it parses order-dependently",
+                )
+            )
+    if "_TRACE_EXT" in structs and "_TRACE_EXT" not in _names_used(loop.test):
+        findings.append(
+            Finding(
+                PASS_ID, sf.path, loop.lineno,
+                "the marker peek loop's guard does not reserve "
+                "_TRACE_EXT's tail — a trace trailer after N extensions "
+                "would be consumed as a truncated extension header",
+            )
+        )
+    return findings
 
 
 def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
@@ -176,6 +240,10 @@ def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
                     f"extension header formats differ ({sorted(hdr_fmts)}) — "
                     "the parser dispatches on ONE peeked header shape",
                 )
+            )
+        if parsers:
+            findings.extend(
+                _ordering_findings(sf, markers, structs, parsers)
             )
 
     if "_TRACE_EXT" in structs:

@@ -31,6 +31,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs import get_registry
 
 logger = logging.getLogger(__name__)
@@ -117,6 +118,15 @@ class SpeculativeReducePhase:
         if hit:
             self._m_cancels.inc()
 
+    def _already_settled(
+        self, idx: int, done: Dict[int, object], failures: Dict[int, Exception]
+    ) -> bool:
+        """Late-loser guard (caller holds the phase lock): once a range
+        settled, every other attempt crossing the line is discarded —
+        the first finisher's result must never be overwritten. Named so
+        the modelcheck mutation gate can disarm exactly this guard."""
+        return idx in done or idx in failures
+
     def _pick_peer(self, suspects: Set[str], tried: Set[str]):
         for w in self._live_workers():
             if w.executor_id in suspects or w.executor_id in tried:
@@ -141,6 +151,7 @@ class SpeculativeReducePhase:
         wake = threading.Event()
 
         def issue(idx: int, worker, clone: bool) -> None:
+            schedule_point("proto", "spec.issue")
             with lock:
                 inflight.setdefault(idx, {})[worker.executor_id] = worker
                 tried.setdefault(idx, set()).add(worker.executor_id)
@@ -150,11 +161,12 @@ class SpeculativeReducePhase:
             )
 
         def settle(idx: int, worker, fut, clone: bool) -> None:
+            schedule_point("proto", "spec.settle")
             losers: List = []
             with lock:
                 flight = inflight.get(idx, {})
                 flight.pop(worker.executor_id, None)
-                if idx in done or idx in failures:
+                if self._already_settled(idx, done, failures):
                     wake.set()
                     return  # a loser crossing the line late
                 err = fut.exception()

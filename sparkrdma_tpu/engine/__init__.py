@@ -1,5 +1,7 @@
 from sparkrdma_tpu.engine.serializer import PickleSerializer, Serializer
 
+__all__ = ["PickleSerializer", "Serializer", "TpuContext"]
+
 
 def __getattr__(name):
     # lazy to avoid a circular import with shuffle.handle

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import itertools
 import random
-from typing import Callable, Iterable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional
 
 from sparkrdma_tpu.shuffle.handle import (
     Aggregator,
@@ -115,7 +115,7 @@ class RDD:
             k, vals = kv
             left = [v for tag, v in vals if tag == 0]
             right = [v for tag, v in vals if tag == 1]
-            return [(k, (l, r)) for l in left for r in right]
+            return [(k, (loc, r)) for loc in left for r in right]
 
         return grouped.flat_map(emit)
 

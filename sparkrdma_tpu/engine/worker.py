@@ -27,7 +27,6 @@ import argparse
 import json
 import socket
 import struct
-import sys
 import threading
 import time
 import traceback
@@ -35,6 +34,7 @@ import traceback
 import cloudpickle
 
 from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs.metrics import get_registry
 from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
@@ -45,6 +45,7 @@ _LEN = struct.Struct(">I")
 
 
 def _recv_obj(sock: socket.socket):
+    schedule_point("proto", "task.recv")
     hdr = b""
     while len(hdr) < 4:
         chunk = sock.recv(4 - len(hdr))
@@ -62,6 +63,7 @@ def _recv_obj(sock: socket.socket):
 
 
 def _send_obj(sock: socket.socket, obj) -> None:
+    schedule_point("proto", "task.send")
     data = cloudpickle.dumps(obj)
     sock.sendall(_LEN.pack(len(data)) + data)
 

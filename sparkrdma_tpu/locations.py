@@ -21,7 +21,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass
 from io import BytesIO
-from typing import BinaryIO, List, Optional
+from typing import BinaryIO, List
 
 _BLOCK = struct.Struct(">QII")  # address(8) length(4) mkey(4)
 

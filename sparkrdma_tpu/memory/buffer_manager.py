@@ -18,9 +18,8 @@ RdmaBufferManager.java). Semantics preserved:
 from __future__ import annotations
 
 import logging
-import threading
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict
 
 from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.memory.buffer import TpuBuffer

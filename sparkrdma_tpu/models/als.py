@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from sparkrdma_tpu.utils.jax_compat import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from sparkrdma_tpu.parallel.mesh import make_mesh, shard_spec
 
@@ -75,7 +75,6 @@ class ALS:
 
     # ------------------------------------------------------------------
     def _build(self, nu, ni, cap_u, cap_i, iters):
-        e = self.num_shards
         axes = tuple(self.mesh.axis_names)
         spec2 = shard_spec(self.mesh)
         k = self.rank

@@ -18,7 +18,7 @@ client-side for inner).
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -127,7 +127,8 @@ class HashJoin:
         def shard_pad(x, fill):
             n = len(x)
             n_local = int(math.ceil(n / e))
-            out = np.full((e * n_local,), fill, dtype=np.uint32 if fill == int(SENTINEL) else np.int32)
+            dtype = np.uint32 if fill == int(SENTINEL) else np.int32
+            out = np.full((e * n_local,), fill, dtype=dtype)
             out[:n] = x
             return out, n_local
 

@@ -22,13 +22,13 @@ collective inside) — compile-once / iterate-many.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from sparkrdma_tpu.utils.jax_compat import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from sparkrdma_tpu.parallel.mesh import make_mesh, shard_spec
 
@@ -75,7 +75,6 @@ class PageRank:
 
     # ------------------------------------------------------------------
     def _build(self, n_local: int, cap: int, iters: int, num_vertices: int):
-        e = self.num_shards
         axes = tuple(self.mesh.axis_names)
         spec = shard_spec(self.mesh)
         alpha = self.damping

@@ -26,7 +26,6 @@ pool re-rounds sizes.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional, Tuple
 
 import jax

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -168,7 +168,6 @@ class TransformerStep:
 
         def ring_attn(q, k, v):
             # q/k/v: [b_loc, s_loc, H, dh]; ring over the sp axis
-            me = jax.lax.axis_index("sp")
             perm = [(i, (i + 1) % sp) for i in range(sp)]
             bl, sl = q.shape[0], q.shape[1]
             m = jnp.full((bl, heads, sl), NEG_INF, jnp.float32)
@@ -202,7 +201,9 @@ class TransformerStep:
 
         def forward_local(params, x):
             bl, sl, _ = x.shape
-            qkv = lambda w: (x @ w).reshape(bl, sl, heads, dhead)
+            def qkv(w):
+                return (x @ w).reshape(bl, sl, heads, dhead)
+
             attn = attn_fn(qkv(params["wq"]), qkv(params["wk"]), qkv(params["wv"]))
             x = x + attn.reshape(bl, sl, d) @ params["wo"]
             # Megatron MLP: column-parallel w1, row-parallel w2; the
@@ -252,7 +253,9 @@ class TransformerStep:
     # ------------------------------------------------------------------
     def place(self, params, x, y):
         mesh = self.mesh
-        put = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+        def put(a, spec):
+            return jax.device_put(a, NamedSharding(mesh, spec))
+
         pl = {
             "wq": put(params["wq"], P()),
             "wk": put(params["wk"], P()),
@@ -309,7 +312,9 @@ def reference_step(params, x, y, n_heads: int, lr: float):
 
     def forward(p, x):
         b, s, _ = x.shape
-        qkv = lambda w: (x @ w).reshape(b, s, n_heads, dhead)
+        def qkv(w):
+            return (x @ w).reshape(b, s, n_heads, dhead)
+
         q, k, v = qkv(p["wq"]), qkv(p["wk"]), qkv(p["wv"])
         sc = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(dhead)
         att = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)

@@ -26,7 +26,7 @@ import enum
 import struct
 from dataclasses import dataclass, field, replace
 from io import BytesIO
-from typing import List, Optional, Sequence
+from typing import List
 
 from sparkrdma_tpu.locations import (
     PartitionLocation,

@@ -46,7 +46,7 @@ import functools
 import logging
 import time
 from contextlib import ExitStack
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -89,7 +89,7 @@ def _compaction_program(rows_b: int, bucket_elems: int, dtype_str: str):
     import jax
     import jax.numpy as jnp
 
-    dtype = jnp.dtype(dtype_str)
+    jnp.dtype(dtype_str)  # validate the cache key up front
     total = rows_b * bucket_elems
 
     def fn(stacked, starts, ends):

@@ -491,7 +491,7 @@ class TpuShuffleFetcherIterator:
             cands = pool.get((pid, block.length), [])
             # prefer the exact published handle (unchanged block); else
             # any re-published sibling of the same length
-            pick = next((l for l in cands if l.block == block), None)
+            pick = next((loc for loc in cands if loc.block == block), None)
             if pick is None and cands:
                 pick = cands[0]
             if pick is not None:

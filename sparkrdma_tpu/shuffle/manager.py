@@ -26,9 +26,10 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import replace
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from sparkrdma_tpu.analysis.lockorder import OrderedLock, named_lock
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
@@ -98,6 +99,12 @@ class TpuShuffleManager:
         # executor dies.
         self._map_owner: Dict[int, Dict[int, str]] = {}
         self._replica_locations: Dict[int, Dict[int, List[PartitionLocation]]] = {}
+        # executors already processed by _on_peer_lost: a straggling
+        # publish from one (a speculative finish racing the loss event)
+        # must be dropped whole — accepting it would double-serve next
+        # to a promoted replica and corrupt the barrier (found by the
+        # modelcheck replica_promotion model)
+        self._lost_executors: Set[str] = set()
         # publish/fetch mutation of ONE shuffle's registry serializes on
         # that shuffle's lock, not the manager-wide ``_lock`` — under a
         # contended map pool, concurrent shuffles' publishes used to
@@ -382,8 +389,34 @@ class TpuShuffleManager:
             except IOError:
                 logger.warning("publish reply to %s failed", msg.requester)
 
+    @staticmethod
+    def _is_replica_publish(msg: PublishPartitionLocationsMsg) -> bool:
+        """A replica publish must divert into the replica registry —
+        serving it beside its live primary would read the same map
+        output twice. Named so the modelcheck mutation gate can disarm
+        the divert and prove the double-serve oracle notices."""
+        return bool(msg.locations) and msg.locations[0].block.is_replica
+
+    def _claim_map_owner(
+        self, owner_map: Dict[int, str], map_id: int, exec_id: str
+    ) -> bool:
+        """First-finisher map-ownership claim (caller holds the shuffle
+        lock). False = a different executor already owns the map — the
+        publish is a speculative clone that lost the race and must be
+        dropped whole. The seam between the read and the write is a
+        model-checker schedule point: the shuffle lock is what makes
+        check-then-claim atomic, and the modelcheck mutation gate proves
+        the checker notices when it is not."""
+        prev = owner_map.get(map_id)
+        if prev is not None and prev != exec_id:
+            return False
+        schedule_point("proto", "manager.publish.claim")
+        owner_map[map_id] = exec_id
+        return True
+
     def _handle_publish(self, msg: PublishPartitionLocationsMsg) -> None:
         if self.is_driver:
+            schedule_point("proto", "manager.publish")
             if msg.is_last and msg.partition_id < 0:
                 # one span per completed writer publish (not per segment)
                 t = obs_now()
@@ -400,11 +433,16 @@ class TpuShuffleManager:
             # replica registry: they must never reach fetch replies or
             # the planner's byte totals until a promotion makes them
             # primary (_on_peer_lost)
-            if msg.locations and msg.locations[0].block.is_replica:
+            if self._is_replica_publish(msg):
                 with self._shuffle_lock(msg.shuffle_id):
                     with self._lock:
                         reg = self._replica_locations.setdefault(msg.shuffle_id, {})
+                        lost = set(self._lost_executors)
                     for loc in msg.locations:
+                        # a replica whose holder is already gone would
+                        # never be pruned again — drop it here
+                        if loc.manager_id.executor_id in lost:
+                            continue
                         if loc.block.is_replica:
                             reg.setdefault(loc.partition_id, []).append(loc)
                 return
@@ -427,13 +465,19 @@ class TpuShuffleManager:
                 ):
                     map_id = msg.locations[0].block.source_map
                     exec_id = msg.locations[0].manager_id.executor_id
-                    prev = owner_map.get(map_id)
-                    if prev is not None and prev != exec_id:
+                    if exec_id in self._lost_executors:
+                        # publisher already swept by _on_peer_lost: its
+                        # replicas were promoted and its counts pruned;
+                        # this straggler's blocks live on a dead node
                         self.registry.counter(
                             "elastic.publishes_dropped", role=self.executor_id
                         ).inc()
                         return
-                    owner_map[map_id] = exec_id
+                    if not self._claim_map_owner(owner_map, map_id, exec_id):
+                        self.registry.counter(
+                            "elastic.publishes_dropped", role=self.executor_id
+                        ).inc()
+                        return
                 for loc in msg.locations:
                     shuffle.setdefault(loc.partition_id, []).append(loc)
                 if msg.is_last and msg.num_map_outputs > 0:
@@ -493,8 +537,10 @@ class TpuShuffleManager:
         executor's death costs zero recompute."""
         if not self.is_driver:
             return
+        schedule_point("proto", "manager.peer_lost")
         with self._lock:
             self._manager_ids.pop(executor_id, None)
+            self._lost_executors.add(executor_id)
             shuffle_ids = (
                 set(self._partition_locations)
                 | set(self._maps_by_exec)
@@ -502,6 +548,9 @@ class TpuShuffleManager:
             )
         for shuffle_id in shuffle_ids:
             promoted_maps: set = set()
+            # per-shuffle seam OUTSIDE the shuffle lock: publishes for
+            # other shuffles may interleave between prune steps
+            schedule_point("proto", "manager.peer_lost.shuffle")
             with self._shuffle_lock(shuffle_id):
                 with self._lock:
                     shuffle = self._partition_locations.get(shuffle_id)
@@ -521,12 +570,33 @@ class TpuShuffleManager:
                     # primary registry (replica_of stays set so the
                     # fetchers' failover rung can identity-match them)
                     promoted_by_holder: Dict[str, set] = {}
+                    promoted_slots: set = set()
                     for pid in list(replicas.keys()):
                         keep: List[PartitionLocation] = []
                         for loc in replicas[pid]:
                             if loc.manager_id.executor_id == executor_id:
                                 continue
                             if loc.block.replica_of == executor_id:
+                                sm = loc.block.source_map
+                                if (
+                                    sm >= 0
+                                    and owner_map is not None
+                                    and owner_map.get(sm, executor_id)
+                                    != executor_id
+                                ):
+                                    # the map is owned by a LIVE primary
+                                    # (the lost executor lost the dedup
+                                    # race to a speculative clone):
+                                    # promoting this replica would serve
+                                    # the same map twice — drop it
+                                    continue
+                                if sm >= 0 and (pid, sm) in promoted_slots:
+                                    # second replica of the same slot
+                                    # (replication factor > 1): one
+                                    # promotion serves it, spares drop
+                                    continue
+                                if sm >= 0:
+                                    promoted_slots.add((pid, sm))
                                 if shuffle is None:
                                     with self._lock:
                                         shuffle = self._partition_locations.setdefault(
@@ -542,20 +612,28 @@ class TpuShuffleManager:
                                 keep.append(loc)
                         replicas[pid] = keep
                     # re-attribute the covered maps to their new holders
-                    # so a later loss of the holder re-arms the barrier
-                    if promoted_maps and by_exec is not None:
+                    # so a later loss of the holder re-arms the barrier.
+                    # A promoted map may have NO owner/attribution entry
+                    # yet (its primary publish raced the loss event and
+                    # was tombstone-dropped): claim it for the holder
+                    # anyway — and credit the barrier for it, since the
+                    # promoted replica IS that map's output — so a
+                    # straggling duplicate publish is deduped instead of
+                    # double-serving beside the promoted replica (found
+                    # by the modelcheck replica_promotion model)
+                    if promoted_maps:
+                        if by_exec is None or owner_map is None:
+                            with self._lock:
+                                by_exec = self._maps_by_exec.setdefault(
+                                    shuffle_id, {}
+                                )
+                                owner_map = self._map_owner.setdefault(
+                                    shuffle_id, {}
+                                )
                         for holder, maps in promoted_by_holder.items():
-                            owned = {
-                                m for m in maps
-                                if owner_map is None
-                                or owner_map.get(m) == executor_id
-                            }
-                            if not owned:
-                                continue
-                            by_exec[holder] = by_exec.get(holder, 0) + len(owned)
-                            if owner_map is not None:
-                                for m in owned:
-                                    owner_map[m] = holder
+                            by_exec[holder] = by_exec.get(holder, 0) + len(maps)
+                            for m in maps:
+                                owner_map[m] = holder
                 if owner_map is not None:
                     # uncovered maps lose their owner: the recompute's
                     # re-publish must be accepted, not deduped away
@@ -566,12 +644,16 @@ class TpuShuffleManager:
                         del owner_map[m]
                 if by_exec is not None:
                     lost = by_exec.pop(executor_id, 0)
-                    if lost:
-                        covered = min(len(promoted_maps), lost)
+                    # barrier delta: every promoted map is now served by
+                    # its replica (+1 each, whether or not the lost
+                    # executor's publish ever counted — a tombstone-
+                    # dropped publish never did), every counted map of
+                    # the lost executor stops being served (-lost);
+                    # promoted maps it did publish cancel out
+                    delta = len(promoted_maps) - lost
+                    if delta:
                         self._maps_done[shuffle_id] = (
-                            self._maps_done.get(shuffle_id, 0)
-                            - lost
-                            + covered
+                            self._maps_done.get(shuffle_id, 0) + delta
                         )
             if promoted_maps:
                 self.registry.counter(
@@ -635,7 +717,7 @@ class TpuShuffleManager:
         chunk = (len(locations) + workers - 1) // workers
         parts = [locations[i : i + chunk] for i in range(0, len(locations), chunk)]
         futs = [
-            pool.submit(lambda ls=ls: [self._with_checksum(l) for l in ls])
+            pool.submit(lambda ls=ls: [self._with_checksum(loc) for loc in ls])
             for ls in parts
         ]
         out: List[PartitionLocation] = []

@@ -44,6 +44,8 @@ import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from sparkrdma_tpu.analysis.lockorder import named_lock
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.shuffle.writer.blocks import MemoryWriterBlock
@@ -153,7 +155,10 @@ class MergeEndpoint:
         self._budget = manager.conf.push_max_buffer_bytes
         self._buffered = 0
         self._shuffles: Dict[int, _ShuffleMergeState] = {}
-        self._lock = threading.Lock()
+        # named (PR 12): the endpoint's ingest/seal critical sections are
+        # schedule-point seams for the protocol model checker, and the
+        # lock-order detector tracks it against manager.state
+        self._lock = named_lock("push.endpoint")
         self._stopped = False
         role = manager.executor_id
         reg = get_registry()
@@ -179,17 +184,18 @@ class MergeEndpoint:
         excluded) — purely informational, pushes are fire-and-forget.
         """
         accepted = 0
+        schedule_point("proto", "merge.push")
         to_seal: List[Tuple[int, List[Tuple[str, int]], Dict]] = []
         with self._lock:
             if self._stopped:
                 return 0
             st = self._shuffles.setdefault(shuffle_id, _ShuffleMergeState())
             for pid, seq, payload in blocks or ():
-                if pid in st.sealed or pid in st.abandoned:
+                if self._closed_locked(st, pid):
                     self._m_dedup.inc()
                     continue
                 per = st.blocks.setdefault(pid, {})
-                if (source, seq) in per:
+                if self._dup_locked(per, source, seq):
                     self._m_dedup.inc()
                     continue
                 n = len(payload)
@@ -213,6 +219,20 @@ class MergeEndpoint:
         for pid, need, payloads in to_seal:
             self._seal(shuffle_id, pid, need, payloads)
         return accepted
+
+    def _closed_locked(self, st: _ShuffleMergeState, pid: int) -> bool:
+        """Sealed/abandoned pids accept no further blocks: no buffer
+        re-entry after a seal popped the payloads, no ledger churn after
+        an abandon freed them. Named predicates (this and
+        :meth:`_dup_locked`) so the modelcheck mutation gate can disarm
+        exactly one guard at a time."""
+        return pid in st.sealed or pid in st.abandoned
+
+    @staticmethod
+    def _dup_locked(per: Dict[Tuple[str, int], bytes], source: str, seq: int) -> bool:
+        """Redelivery dedup: pushes are fire-and-forget and the task
+        protocol may retry, so ``(source, seq)`` must be idempotent."""
+        return (source, seq) in per
 
     def _abandon_locked(self, st: _ShuffleMergeState, pid: int) -> None:
         per = st.blocks.pop(pid, None)
@@ -262,6 +282,7 @@ class MergeEndpoint:
         payloads: Dict[Tuple[str, int], bytes],
     ) -> None:
         """Concatenate coverage into one registered segment + publish."""
+        schedule_point("proto", "merge.seal")
         manager = self._manager
         total = sum(len(payloads[k]) for k in need)
         admitted = total > 0 and manager.resolver.reserve_inmemory_bytes(total)

@@ -55,6 +55,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.shuffle.writer.pipeline import PipelineReport, _STAGE_BOUNDS
 
@@ -216,6 +217,7 @@ class ReduceTaskPipeline:
                         fail(e)
                         inflight.add(-1)
                         break
+                    schedule_point("queue", "reader.decode_q.put")
                     decode_q.put((idx, item, fetched))
                     idx += 1
             except BaseException as e:  # noqa: BLE001
@@ -228,6 +230,7 @@ class ReduceTaskPipeline:
 
         def decode_main() -> None:
             while True:
+                schedule_point("queue", "reader.decode_q.get")
                 got = decode_q.get()
                 if got is _CLOSE:
                     decode_q.put(_CLOSE)  # release sibling workers
@@ -300,6 +303,7 @@ class ReduceTaskPipeline:
                 fail(e)
                 discard("stage", item, staged)
                 return
+            schedule_point("queue", "reader.out_q.put")
             out_q.put((idx, out))
 
         def stage_main() -> None:
@@ -316,12 +320,14 @@ class ReduceTaskPipeline:
                 if self._double_buffer:
                     # hand off: the NEXT item's host->HBM stage fills
                     # its buffer while the merge thread drains this one
+                    schedule_point("queue", "reader.merge_q.put")
                     merge_q.put((idx, item, staged))
                 else:
                     merge_one(idx, item, staged)
 
         def merge_main() -> None:
             while True:
+                schedule_point("queue", "reader.merge_q.get")
                 got = merge_q.get()
                 if got is _CLOSE:
                     return

@@ -14,7 +14,7 @@ from __future__ import annotations
 import mmap
 import os
 import threading
-from typing import BinaryIO, Optional
+from typing import BinaryIO
 
 from sparkrdma_tpu.locations import BlockLocation
 from sparkrdma_tpu.memory.buffer import TpuBuffer

@@ -34,7 +34,7 @@ import logging
 import pickle
 import struct
 import threading
-from typing import BinaryIO, Dict, List, Optional, Sequence
+from typing import BinaryIO, Dict, List, Optional
 
 from sparkrdma_tpu.engine.serializer import frame_compressed
 from sparkrdma_tpu.locations import PartitionLocation

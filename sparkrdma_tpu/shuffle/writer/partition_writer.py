@@ -16,7 +16,6 @@ preserved:
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import BinaryIO, List
 

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs import get_registry
 
 STAGES = ("sort", "stage", "publish")
@@ -157,6 +158,7 @@ class MapTaskPipeline:
                 # blocking put IS the backpressure; an abort raised
                 # downstream closes the queues only after draining, so
                 # this never deadlocks
+                schedule_point("queue", "writer.stage_q.put")
                 stage_q.put((idx, out))
             except BaseException as e:  # noqa: BLE001 — latch and drain
                 inflight.add(-1)
@@ -164,6 +166,7 @@ class MapTaskPipeline:
 
         def stage_main() -> None:
             while True:
+                schedule_point("queue", "writer.stage_q.get")
                 got = stage_q.get()
                 if got is _CLOSE:
                     publish_q.put(_CLOSE)
@@ -178,6 +181,7 @@ class MapTaskPipeline:
                         if self._stage_fn is not None
                         else sorted_out
                     )
+                    schedule_point("queue", "writer.publish_q.put")
                     publish_q.put((idx, staged))
                 except BaseException as e:  # noqa: BLE001
                     inflight.add(-1)
@@ -185,6 +189,7 @@ class MapTaskPipeline:
 
         def publish_main() -> None:
             while True:
+                schedule_point("queue", "writer.publish_q.get")
                 got = publish_q.get()
                 if got is _CLOSE:
                     return

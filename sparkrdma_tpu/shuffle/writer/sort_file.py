@@ -12,7 +12,6 @@ role), then concatenated into the final data-tmp file.
 
 from __future__ import annotations
 
-import os
 import tempfile
 from typing import Iterable, List, Tuple
 

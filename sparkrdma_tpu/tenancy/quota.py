@@ -30,6 +30,7 @@ import time
 from typing import Dict, Optional
 
 from sparkrdma_tpu.analysis.lockorder import named_lock
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs import get_registry
 
 logger = logging.getLogger(__name__)
@@ -77,21 +78,29 @@ class QuotaBroker:
         q = self.quota_for(tenant)
         return q > 0 and self.usage(tenant) > q
 
+    def _must_block(self, tenant: str, nbytes: int, quota: int) -> bool:
+        """Backpressure predicate (caller holds the broker lock): block
+        only while THIS tenant already holds bytes and the charge would
+        overshoot. Per-tenant by design — isolation means one tenant at
+        its quota never blocks another — and named so the modelcheck
+        mutation gate can swap in the global-usage bug it guards
+        against."""
+        held = self._usage.get(tenant, 0)
+        return held > 0 and held + nbytes > quota
+
     def charge(self, tenant: str, nbytes: int) -> None:
         """Account nbytes to tenant, blocking at the quota.
 
         Blocks only while the tenant already holds bytes (progress
         guarantee) and only the offending tenant's thread — other
         tenants charge through the same lock without waiting."""
+        schedule_point("proto", "quota.charge")
         quota = self.quota_for(tenant)
         blocked_at: Optional[float] = None
         with self._cond:
             if quota > 0:
                 deadline = None
-                while (
-                    self._usage.get(tenant, 0) > 0
-                    and self._usage.get(tenant, 0) + nbytes > quota
-                ):
+                while self._must_block(tenant, nbytes, quota):
                     now = time.perf_counter()
                     if blocked_at is None:
                         blocked_at = now
@@ -115,6 +124,7 @@ class QuotaBroker:
             )
 
     def release(self, tenant: str, nbytes: int) -> None:
+        schedule_point("proto", "quota.release")
         with self._cond:
             self._usage[tenant] = max(0, self._usage.get(tenant, 0) - nbytes)
             self._g_bytes(tenant).set(self._usage[tenant])

@@ -22,7 +22,6 @@ import logging
 import os
 import threading
 import time
-from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -273,7 +272,9 @@ class NativeTpuChannel:
         self._m_send_bytes.inc(sum(len(s) for s in segments))
         permits = max(1, len(segments))
         wrapped = self._wrap_reclaim(listener, permits)
-        post = lambda: self._node._post_send(self, wrapped, segments)
+        def post():
+            self._node._post_send(self, wrapped, segments)
+
         if self._acquire_or_queue(permits, (permits, post)):
             post()
 
@@ -295,7 +296,9 @@ class NativeTpuChannel:
         self._m_read_bytes.inc(total)
         permits = max(1, len(blocks))
         wrapped = self._wrap_reclaim(listener, permits)
-        post = lambda: self._node._post_read(self, wrapped, dst_views, blocks)
+        def post():
+            self._node._post_read(self, wrapped, dst_views, blocks)
+
         if self._acquire_or_queue(permits, (permits, post)):
             post()
 
@@ -319,7 +322,9 @@ class NativeTpuChannel:
         self._m_read_bytes.inc(sum(b[2] for b in blocks))
         permits = max(1, len(blocks))
         wrapped = self._wrap_reclaim(listener, permits)
-        post = lambda: self._node._post_read_mapped(self, wrapped, blocks)
+        def post():
+            self._node._post_read_mapped(self, wrapped, blocks)
+
         if self._acquire_or_queue(permits, (permits, post)):
             post()
 

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.memory.buffer_manager import TpuBufferManager
 from sparkrdma_tpu.memory.registry import ProtectionDomain
 from sparkrdma_tpu.obs import get_registry
@@ -222,6 +223,7 @@ class TpuNode:
                     get_registry().counter(
                         "transport.connect_retries", purpose=purpose
                     ).inc()
+                    schedule_point("timer", "transport.backoff")
                     time.sleep(min(0.05 * (2**attempt), 1.0))
             if ch is None:
                 raise ChannelError(

@@ -12,11 +12,10 @@ heap-merge of every run.
 from __future__ import annotations
 
 import heapq
-import itertools
 import os
 import pickle
 import tempfile
-from typing import Callable, Iterable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterable, Iterator, List, Optional
 
 
 def _default_key(record):

@@ -3,7 +3,6 @@
 import math
 
 import numpy as np
-import pytest
 
 from sparkrdma_tpu.models.als import ALS, reference_als, rmse
 from sparkrdma_tpu.parallel.mesh import make_mesh

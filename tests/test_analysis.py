@@ -117,11 +117,22 @@ class Codec:
 """
 
 
+# a parser that satisfies the ordering invariant: one while peek loop
+# dispatching every marker, each branch ending in `continue`
+_LOOPED_PARSER = """\
+i = 0
+        while i < len(b):
+            m = self._EXT_HDR.unpack_from(b, i)[0]
+            if m == self._DEV_MARKER:
+                self._DEV_ITEM.unpack_from(b, i + 6)
+                i += 14
+                continue
+            break
+        return i"""
+
+
 def test_wire_pass_catches_low_marker_value():
-    src = _WIRE_TEMPLATE.format(
-        marker="0x0010",
-        parser_body="return self._EXT_HDR, self._DEV_MARKER, self._DEV_ITEM",
-    )
+    src = _WIRE_TEMPLATE.format(marker="0x0010", parser_body=_LOOPED_PARSER)
     found = _findings(SourceFile("sparkrdma_tpu/fake_rpc.py", src), "wire-markers")
     assert len(found) == 1
     assert "0xFF00" in found[0].message
@@ -139,14 +150,32 @@ def test_wire_pass_catches_one_sided_extension():
 
 
 def test_wire_pass_clean_fixture_and_path_scoping():
-    src = _WIRE_TEMPLATE.format(
-        marker="0xFF10",
-        parser_body="return self._EXT_HDR, self._DEV_MARKER, self._DEV_ITEM",
-    )
+    src = _WIRE_TEMPLATE.format(marker="0xFF10", parser_body=_LOOPED_PARSER)
     assert _findings(SourceFile("sparkrdma_tpu/fake_rpc.py", src), "wire-markers") == []
     # the same planted breakage outside *rpc.py/*locations.py is out of scope
     bad = _WIRE_TEMPLATE.format(marker="0x0010", parser_body="return b")
     assert _findings(SourceFile("sparkrdma_tpu/fake_other.py", bad), "wire-markers") == []
+
+
+def test_wire_pass_ordering_requires_peek_loop():
+    # marker dispatched straight-line (no while loop): parse order is fixed
+    src = _WIRE_TEMPLATE.format(
+        marker="0xFF10",
+        parser_body="return self._EXT_HDR, self._DEV_MARKER, self._DEV_ITEM",
+    )
+    found = _findings(SourceFile("sparkrdma_tpu/fake_rpc.py", src), "wire-markers")
+    assert len(found) == 1
+    assert "peek loop" in found[0].message
+
+
+def test_wire_pass_ordering_requires_continue_per_branch():
+    # loop dispatches the marker but the branch falls through instead of
+    # re-peeking: every extension after it parses order-dependently
+    body = _LOOPED_PARSER.replace("                continue\n", "")
+    src = _WIRE_TEMPLATE.format(marker="0xFF10", parser_body=body)
+    found = _findings(SourceFile("sparkrdma_tpu/fake_rpc.py", src), "wire-markers")
+    assert len(found) == 1
+    assert "continue" in found[0].message
 
 
 # -- tenant-scope ----------------------------------------------------------

@@ -43,7 +43,7 @@ def test_publish_msg_device_extension_roundtrip():
     out = [RpcMsg.parse_segment(s) for s in msg.to_segments(4096)]
     got = sorted(
         (loc for m in out for loc in m.locations),
-        key=lambda l: l.partition_id,
+        key=lambda loc: loc.partition_id,
     )
     assert (got[0].block.device_coords, got[0].block.arena_handle,
             got[0].block.arena_offset) == (3, 11, 4096)
@@ -65,16 +65,16 @@ def test_publish_msg_without_device_is_byte_identical_legacy():
         2, -1,
         [
             PartitionLocation(
-                l.manager_id, l.partition_id,
-                BlockLocation(l.block.address, l.block.length, l.block.mkey),
+                loc.manager_id, loc.partition_id,
+                BlockLocation(loc.block.address, loc.block.length, loc.block.mkey),
             )
-            for l in locs
+            for loc in locs
         ],
     )
     assert msg.to_segments(4096) == baseline.to_segments(4096)
     (seg,) = msg.to_segments(4096)
     m = RpcMsg.parse_segment(seg)
-    assert [l.block.arena_handle for l in m.locations] == [0, 0]
+    assert [loc.block.arena_handle for loc in m.locations] == [0, 0]
 
 
 def test_publish_msg_device_ext_survives_segmentation():
@@ -91,9 +91,9 @@ def test_publish_msg_device_ext_survives_segmentation():
     for seg in segments:
         got.extend(RpcMsg.parse_segment(seg).locations)
     assert len(got) == 40
-    for i, l in enumerate(sorted(got, key=lambda x: x.partition_id)):
-        assert (l.block.device_coords, l.block.arena_handle,
-                l.block.arena_offset) == (i % 4, i + 1, i * 64)
+    for i, loc in enumerate(sorted(got, key=lambda x: x.partition_id)):
+        assert (loc.block.device_coords, loc.block.arena_handle,
+                loc.block.arena_offset) == (i % 4, i + 1, i * 64)
 
 
 # ----------------------------------------------------------------------

@@ -347,7 +347,7 @@ def test_publish_msg_elastic_extension_roundtrip():
     out = [RpcMsg.parse_segment(s) for s in msg.to_segments(4096)]
     got = sorted(
         (loc for m in out for loc in m.locations),
-        key=lambda l: l.partition_id,
+        key=lambda loc: loc.partition_id,
     )
     assert got[0].block.replica_of == "proc-exec-1"
     assert got[0].block.source_map == 3
@@ -363,10 +363,10 @@ def test_publish_msg_without_elastic_tags_is_byte_identical_legacy():
         2, -1,
         [
             PartitionLocation(
-                l.manager_id, l.partition_id,
-                BlockLocation(l.block.address, l.block.length, l.block.mkey),
+                loc.manager_id, loc.partition_id,
+                BlockLocation(loc.block.address, loc.block.length, loc.block.mkey),
             )
-            for l in locs
+            for loc in locs
         ],
     )
     assert msg.to_segments(4096) == baseline.to_segments(4096)
@@ -387,9 +387,9 @@ def test_publish_msg_elastic_ext_survives_segmentation():
     for seg in segments:
         got.extend(RpcMsg.parse_segment(seg).locations)
     assert len(got) == 40
-    for i, l in enumerate(sorted(got, key=lambda x: x.partition_id)):
-        assert l.block.replica_of == f"proc-exec-{i % 4}"
-        assert l.block.source_map == i
+    for i, loc in enumerate(sorted(got, key=lambda x: x.partition_id)):
+        assert loc.block.replica_of == f"proc-exec-{i % 4}"
+        assert loc.block.source_map == i
 
 
 # ----------------------------------------------------------------------

@@ -6,7 +6,6 @@ import random
 import pytest
 
 from sparkrdma_tpu.engine.context import TpuContext
-from sparkrdma_tpu.utils.config import TpuShuffleConf
 
 
 @pytest.fixture(scope="module")

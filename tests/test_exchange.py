@@ -8,7 +8,6 @@ through the exchange, length-prefix integrity, and schedule equivalence
 import numpy as np
 import pytest
 
-import jax
 
 from sparkrdma_tpu.ops.exchange import (
     ExchangeProgram,

@@ -23,7 +23,7 @@ def test_single_process_sweep_runs_and_verifies(capsys):
     # e=2 flat mesh (subset of the 8-device farm), one tiny bucket
     exchange_study.run_child(2, 1, [2048], 1)
     line = [
-        l for l in capsys.readouterr().out.splitlines() if l.startswith("RESULT ")
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("RESULT ")
     ][-1]
     records = json.loads(line[len("RESULT "):])
     assert {r["schedule"] for r in records} == {"a2a", "ring"}

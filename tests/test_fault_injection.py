@@ -392,7 +392,6 @@ def test_stage_seam_corrupt_in_decode_detected_and_reordered():
         ex1.publish_partition_locations(77, -1, locs, num_map_outputs=1)
 
         io = DeviceShuffleIO(ex0)
-        delivered = []
 
         def fetch_group(r):
             return io.fetch_host_blocks(77, r, r + 1, timeout_s=30)[r]

@@ -1,7 +1,6 @@
 """Distributed hash join vs a dict-based reference."""
 
 import numpy as np
-import pytest
 
 from sparkrdma_tpu.models.hashjoin import HashJoin
 from sparkrdma_tpu.parallel.mesh import make_mesh

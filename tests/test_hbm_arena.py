@@ -7,7 +7,6 @@ import pytest
 
 from sparkrdma_tpu.ops.hbm_arena import (
     MIN_BLOCK_SIZE,
-    DeviceBuffer,
     DeviceBufferManager,
     _size_class,
 )

@@ -208,7 +208,7 @@ def test_peer_death_fails_send_and_read_listeners():
     b = NativeTpuNode(conf, "127.0.0.1", True, "death-b")
     ch = a.get_channel("127.0.0.1", b.port)
     src = memoryview(bytes(1024))
-    mkey = b.pd.register(src)
+    b.pd.register(src)
     b.stop()  # peer dies
 
     failures = []

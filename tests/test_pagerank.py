@@ -1,7 +1,6 @@
 """Device PageRank (multi-round all-to-all) vs numpy power iteration."""
 
 import numpy as np
-import pytest
 
 from sparkrdma_tpu.models.pagerank import PageRank, reference_pagerank
 from sparkrdma_tpu.parallel.mesh import make_mesh

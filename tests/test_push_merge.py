@@ -9,9 +9,7 @@ endpoint's dedup/budget/seal accounting, the wire extension's legacy
 byte-identity, and the manager-level e2e where the reduce side's
 per-partition reads collapse to one merged read each."""
 
-import threading
 
-import pytest
 
 from sparkrdma_tpu.locations import (
     BlockLocation,
@@ -94,7 +92,7 @@ def test_publish_msg_merged_ext_roundtrip_and_legacy_identity():
     msg = PublishPartitionLocationsMsg(7, -1, merged_locs)
     (seg,) = msg.to_segments(4096)
     parsed = RpcMsg.parse_segment(seg)
-    assert [l.block.merged_cover for l in parsed.locations] == [0, 0, 2]
+    assert [loc.block.merged_cover for loc in parsed.locations] == [0, 0, 2]
 
     # legacy byte-identity: cover-0-only frames carry ZERO extension bytes
     plain = PublishPartitionLocationsMsg(7, -1, locs)
@@ -102,10 +100,10 @@ def test_publish_msg_merged_ext_roundtrip_and_legacy_identity():
         7, -1,
         [
             PartitionLocation(
-                l.manager_id, l.partition_id,
-                BlockLocation(l.block.address, l.block.length, l.block.mkey),
+                loc.manager_id, loc.partition_id,
+                BlockLocation(loc.block.address, loc.block.length, loc.block.mkey),
             )
-            for l in locs
+            for loc in locs
         ],
     )
     assert plain.to_segments(4096) == baseline.to_segments(4096)
@@ -122,8 +120,8 @@ def test_publish_msg_merged_ext_survives_segmentation():
     got = []
     for seg in segments:
         got.extend(RpcMsg.parse_segment(seg).locations)
-    for i, l in enumerate(sorted(got, key=lambda x: x.partition_id)):
-        assert l.block.merged_cover == i % 3
+    for i, loc in enumerate(sorted(got, key=lambda x: x.partition_id)):
+        assert loc.block.merged_cover == i % 3
 
 
 # ----------------------------------------------------------------------
@@ -172,7 +170,7 @@ def test_merge_endpoint_dedup_and_coverage_seal():
         deadline = _time.time() + 10
         while _time.time() < deadline and not merged_locs:
             locs = driver._partition_locations.get(31, {}).get(0, [])
-            merged_locs = [l for l in locs if l.block.merged_cover]
+            merged_locs = [loc for loc in locs if loc.block.merged_cover]
             if not merged_locs:
                 _time.sleep(0.05)
         assert len(merged_locs) == 1
@@ -213,7 +211,7 @@ def test_merge_endpoint_budget_drop_falls_back():
         assert _counter_total(delta, "budget_drops") >= 1
         assert _counter_total(delta, "merge_segments") == 0
         locs = driver._partition_locations.get(32, {}).get(0, [])
-        assert not [l for l in locs if l.block.merged_cover]
+        assert not [loc for loc in locs if loc.block.merged_cover]
     finally:
         ex.stop()
         driver.stop()
@@ -319,7 +317,7 @@ def test_push_disabled_output_identical_and_legacy_frames():
                 w.stop(True)
             ex.finalize_maps(0)
             locs = ex.fetch_remote_partition_locations(0, 0, 3).result(timeout=10)
-            merged_locs = [l for l in locs if l.block.merged_cover]
+            merged_locs = [loc for loc in locs if loc.block.merged_cover]
             if push_on:
                 assert merged_locs
             else:

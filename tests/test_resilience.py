@@ -175,7 +175,7 @@ def test_publish_msg_checksum_extension_roundtrip():
     out = [RpcMsg.parse_segment(seg) for seg in segments]
     got = [loc for m in out for loc in m.locations]
     assert [
-        (l.partition_id, l.block.checksum, l.block.checksum_algo) for l in got
+        (loc.partition_id, loc.block.checksum, loc.block.checksum_algo) for loc in got
     ] == [
         (0, 0xDEADBEEF, checksum.ALGO_CRC32),
         (1, 0x12345678, checksum.ALGO_CRC32),
@@ -196,16 +196,16 @@ def test_publish_msg_without_checksums_is_legacy_compatible():
         -1,
         [
             PartitionLocation(
-                l.manager_id, l.partition_id,
-                BlockLocation(l.block.address, l.block.length, l.block.mkey),
+                loc.manager_id, loc.partition_id,
+                BlockLocation(loc.block.address, loc.block.length, loc.block.mkey),
             )
-            for l in locs
+            for loc in locs
         ],
     )
     assert msg.to_segments(4096) == baseline.to_segments(4096)
     (seg,) = msg.to_segments(4096)
     m = RpcMsg.parse_segment(seg)
-    assert [l.block.checksum_algo for l in m.locations] == [0, 0]
+    assert [loc.block.checksum_algo for loc in m.locations] == [0, 0]
     assert m.shuffle_id == 2 and m.partition_id == -1
 
 
@@ -223,8 +223,8 @@ def test_publish_msg_checksum_survives_segmentation():
     for seg in segments:
         got.extend(RpcMsg.parse_segment(seg).locations)
     assert len(got) == 40
-    for i, l in enumerate(sorted(got, key=lambda x: x.partition_id)):
-        assert l.block.checksum == i * 7 + 1
+    for i, loc in enumerate(sorted(got, key=lambda x: x.partition_id)):
+        assert loc.block.checksum == i * 7 + 1
 
 
 # ----------------------------------------------------------------------
@@ -285,8 +285,8 @@ def test_fault_plan_counting_and_after():
 
     listeners = [_L() for _ in range(4)]
     handled = []
-    for l in listeners:
-        _, h = plan.on_read(_Chan(), l, [bytearray(4)], [(0, 0, 4)])
+    for lst in listeners:
+        _, h = plan.on_read(_Chan(), lst, [bytearray(4)], [(0, 0, 4)])
         handled.append(h)
     # first call skipped (after=1), next two fire, budget then exhausted
     assert handled == [False, True, True, False]

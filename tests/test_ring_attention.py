@@ -3,7 +3,6 @@
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from sparkrdma_tpu.ops.ring_attention import RingAttention, reference_attention
@@ -12,7 +11,9 @@ from sparkrdma_tpu.parallel.mesh import make_mesh
 
 def _inputs(b=2, s=64, h=2, d=16, seed=0):
     rng = np.random.default_rng(seed)
-    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+    def mk():
+        return jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+
     return mk(), mk(), mk()
 
 
