@@ -734,6 +734,309 @@ def bench_striping_ab() -> dict:
     return out
 
 
+def bench_iouring_read_ab(dry_run: bool = False) -> dict:
+    """Interleaved pread-vs-io_uring backend A/B pairs, SAME run.
+
+    The submission plane (DESIGN.md §24) lets the same-host read path
+    swap backends under an unchanged caller: the A side forces
+    ``readBackend=pread`` (per-run preadv2 scatter), the B side
+    ``readBackend=iouring`` (batched SQEs, fixed buffers registered
+    once per worker ring, one ``io_uring_enter`` per task). Same
+    channel, same region, same rotating destination set; bytes are
+    verified under BOTH backends before timing — the A/B's first job
+    is proving byte identity, its second is measuring the syscall
+    batching. Where io_uring is unavailable (old kernel, seccomp,
+    ``SPARKRDMA_NATIVE_NO_IOURING`` build) the row records the
+    degradation honestly instead of timing pread against itself. On a
+    1-core page-cache-resident rig the win is bounded by syscall
+    count, not I/O parallelism — ``cores`` is recorded so the ledger
+    stays interpretable."""
+    import os
+    import tempfile
+
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport import FnListener
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    out = {}
+    rng = np.random.default_rng(23)
+    srv = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", False, "uab-srv")
+    cli = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", True, "uab-cli")
+    n_blocks = READ_REGION // READ_BLOCK
+    N_PAIRS = 1 if dry_run else 3
+    ROUNDS_PER_SIDE = 2 if dry_run else 4
+    dsts = [memoryview(bytearray(READ_BLOCK)) for _ in range(n_blocks)]
+    try:
+        ch = cli.get_channel("127.0.0.1", srv.port)
+        src = rng.integers(0, 256, size=READ_REGION, dtype=np.uint8)
+        buf = TpuBuffer(srv.pd, READ_REGION, register=True)
+        np.frombuffer(buf.view, dtype=np.uint8)[:] = src
+
+        def one_round(label):
+            evs, errs = [], []
+            for i in range(n_blocks):
+                ev = threading.Event()
+
+                def fail(e, ev=ev):
+                    errs.append(e)
+                    ev.set()
+
+                ch.read_in_queue(
+                    FnListener(lambda _, ev=ev: ev.set(), fail),
+                    [dsts[i]], [(buf.mkey, i * READ_BLOCK, READ_BLOCK)],
+                )
+                evs.append(ev)
+            for ev in evs:
+                assert ev.wait(120), f"{label}: iouring A/B read timed out"
+            if errs:
+                raise SystemExit(
+                    f"BENCH FAILED: iouring A/B READ error: {errs[0]}"
+                )
+
+        def verify(label):
+            for i in (0, 1, n_blocks - 1):
+                if not np.array_equal(
+                    np.frombuffer(dsts[i], np.uint8),
+                    src[i * READ_BLOCK: (i + 1) * READ_BLOCK],
+                ):
+                    raise SystemExit(
+                        f"BENCH FAILED: {label} READ bytes differ"
+                    )
+
+        def timed_side(backend):
+            cli.set_read_backend(backend)
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS_PER_SIDE):
+                one_round(backend)
+            dt = time.perf_counter() - t0
+            return ROUNDS_PER_SIDE * READ_REGION / dt / 1e9
+
+        # warm + byte-identity check, BOTH backends, before any timing
+        cli.set_read_backend("iouring")
+        one_round("iouring-warm")
+        verify("iouring")
+        stats = cli.sq_stats()
+        cli.set_read_backend("pread")
+        one_round("pread-warm")
+        verify("pread")
+        fast, _ = cli.read_path_stats()
+        if fast == 0:
+            raise SystemExit(
+                "BENCH FAILED: iouring A/B never took the fast path"
+            )
+        row = {
+            "uring_compiled": stats.get("uring_compiled"),
+            "iouring_available": stats.get("backend") == "iouring",
+            "backend_fallbacks": stats.get("backend_fallbacks"),
+            "cores": os.cpu_count() or 1,
+        }
+        if stats.get("backend") != "iouring":
+            # degradation is the result, not an error: pread served the
+            # warm round byte-identically and the fallback was counted
+            row["skip_reason"] = (
+                "io_uring unavailable on this rig/build; timing pread "
+                "against itself would be noise"
+            )
+            out["ab_iouring_read"] = row
+            buf.free()
+            return out
+
+        s0 = cli.sq_stats()
+        pairs = []
+        for _ in range(N_PAIRS):
+            a = timed_side("pread")
+            b = timed_side("iouring")
+            pairs.append(
+                {"pread_gbps": round(a, 3), "iouring_gbps": round(b, 3)}
+            )
+        s1 = cli.sq_stats()
+        med_a = float(np.median([p["pread_gbps"] for p in pairs]))
+        med_b = float(np.median([p["iouring_gbps"] for p in pairs]))
+
+        # machine roofline for this path: raw page-cache pread of the
+        # same volume into the same rotating destination set
+        with tempfile.NamedTemporaryFile(dir="/dev/shm") as f:
+            f.write(src.tobytes())
+            f.flush()
+            rfd = f.fileno()
+            for i in range(n_blocks):
+                os.preadv(rfd, [dsts[i]], i * READ_BLOCK)
+            t0 = time.perf_counter()
+            moved = 0
+            for _ in range(ROUNDS_PER_SIDE):
+                for i in range(n_blocks):
+                    moved += os.preadv(rfd, [dsts[i]], i * READ_BLOCK)
+            roofline = moved / (time.perf_counter() - t0) / 1e9
+
+        d_submits = s1["submits"] - s0["submits"]
+        d_batches = s1["batches"] - s0["batches"]
+        row.update({
+            "pairs": pairs,
+            "pread_gbps": round(med_a, 3),
+            "iouring_gbps": round(med_b, 3),
+            "iouring_speedup": round(med_b / med_a, 3) if med_a else None,
+            "sq_submits": d_submits,
+            "sq_batches": d_batches,
+            "sqe_batching_factor": (
+                round(d_submits / d_batches, 2) if d_batches else None
+            ),
+            "pread_roofline_gbps": round(roofline, 3),
+            "roofline_fraction": (
+                round(med_b / roofline, 3) if roofline else None
+            ),
+        })
+        out["ab_iouring_read"] = row
+        buf.free()
+    finally:
+        cli.stop()
+        srv.stop()
+    return out
+
+
+def bench_consume_sharded_ab(dry_run: bool = False) -> dict:
+    """Interleaved inline-vs-sharded consume A/B pairs, SAME run.
+
+    ``tpu.shuffle.native.consumeWorkers`` shards READ_DONE completion
+    work (checksum + decode + delivery) across lanes routed by channel
+    (DESIGN.md §24); this A/B isolates exactly that seam. Both sides
+    run the SAME fetch-to-consumed shape — read a region's blocks
+    round-robin over 4 connections, uint8-sum every byte in the
+    completion listener — but the A client consumes inline on its poll
+    thread (``consumeWorkers=1``) while the B client's 4 lanes run the
+    sums concurrently with the poll loop and each other (the sum
+    releases the GIL). Sums are verified both sides every round, so
+    sharding is proven order-safe and byte-identical before it is
+    credited with anything. On a 1-core rig the lanes can only overlap
+    consume with poll-loop bookkeeping, so ~1x is honest — the ≥90%
+    consume-roofline expectation applies where cores exist (``cores``
+    recorded)."""
+    import os
+
+    from sparkrdma_tpu.memory.buffer import TpuBuffer
+    from sparkrdma_tpu.transport import FnListener
+    from sparkrdma_tpu.transport.native_node import NativeTpuNode
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    out = {}
+    rng = np.random.default_rng(29)
+    LANES = 4
+    srv = NativeTpuNode(TpuShuffleConf(), "127.0.0.1", False, "sab-srv")
+    cli_i = NativeTpuNode(
+        TpuShuffleConf({"tpu.shuffle.native.consumeWorkers": "1"}),
+        "127.0.0.1", True, "sab-cli-inline",
+    )
+    cli_s = NativeTpuNode(
+        TpuShuffleConf({"tpu.shuffle.native.consumeWorkers": str(LANES)}),
+        "127.0.0.1", True, "sab-cli-sharded",
+    )
+    n_blocks = READ_REGION // READ_BLOCK
+    N_PAIRS = 1 if dry_run else 3
+    ROUNDS_PER_SIDE = 2 if dry_run else 4
+    dsts = [memoryview(bytearray(READ_BLOCK)) for _ in range(n_blocks)]
+    try:
+        src = rng.integers(0, 256, size=READ_REGION, dtype=np.uint8)
+        buf = TpuBuffer(srv.pd, READ_REGION, register=True)
+        np.frombuffer(buf.view, dtype=np.uint8)[:] = src
+        want_round = int(np.add.reduce(src, dtype=np.int64))
+        # lanes shard by channel: spread the region over LANES distinct
+        # connections so the B side actually exercises every lane
+        ch_i = [
+            cli_i.get_channel("127.0.0.1", srv.port, purpose=f"data-{j}")
+            for j in range(LANES)
+        ]
+        ch_s = [
+            cli_s.get_channel("127.0.0.1", srv.port, purpose=f"data-{j}")
+            for j in range(LANES)
+        ]
+
+        def one_round(channels, label):
+            sums = [0] * n_blocks
+            evs, errs = [], []
+            for i in range(n_blocks):
+                ev = threading.Event()
+
+                def ok(_, i=i, ev=ev):
+                    # THE consume: full-speed sum of the landed block,
+                    # on whatever thread the node's consume plane picks
+                    sums[i] = int(np.add.reduce(
+                        np.frombuffer(dsts[i], np.uint8), dtype=np.int64
+                    ))
+                    ev.set()
+
+                def fail(e, ev=ev):
+                    errs.append(e)
+                    ev.set()
+
+                channels[i % len(channels)].read_in_queue(
+                    FnListener(ok, fail),
+                    [dsts[i]], [(buf.mkey, i * READ_BLOCK, READ_BLOCK)],
+                )
+                evs.append(ev)
+            for ev in evs:
+                assert ev.wait(120), f"{label}: consume A/B read timed out"
+            if errs:
+                raise SystemExit(
+                    f"BENCH FAILED: {label} READ error: {errs[0]}"
+                )
+            if sum(sums) != want_round:
+                raise SystemExit(
+                    f"BENCH FAILED: {label} consume A/B sums differ"
+                )
+
+        def timed_side(channels, label):
+            t0 = time.perf_counter()
+            for _ in range(ROUNDS_PER_SIDE):
+                one_round(channels, label)
+            dt = time.perf_counter() - t0
+            return ROUNDS_PER_SIDE * READ_REGION / dt / 1e9
+
+        one_round(ch_i, "inline-warm")
+        one_round(ch_s, "sharded-warm")
+        if cli_s.sq_stats().get("consume_workers") != LANES:
+            raise SystemExit(
+                "BENCH FAILED: sharded client has no consume lanes"
+            )
+        pairs = []
+        for _ in range(N_PAIRS):
+            a = timed_side(ch_i, "inline")
+            b = timed_side(ch_s, "sharded")
+            pairs.append(
+                {"inline_gbps": round(a, 3), "sharded_gbps": round(b, 3)}
+            )
+        med_a = float(np.median([p["inline_gbps"] for p in pairs]))
+        med_b = float(np.median([p["sharded_gbps"] for p in pairs]))
+
+        # this comparison's machine limit: the one-pass consume over
+        # the same rotating set with delivery assumed free
+        t0 = time.perf_counter()
+        moved = 0
+        for _ in range(ROUNDS_PER_SIDE):
+            for d in dsts:
+                np.add.reduce(np.frombuffer(d, np.uint8), dtype=np.int64)
+                moved += READ_BLOCK
+        roofline = moved / (time.perf_counter() - t0) / 1e9
+
+        out["ab_consume_sharded"] = {
+            "pairs": pairs,
+            "inline_consumed_gbps": round(med_a, 3),
+            "sharded_consumed_gbps": round(med_b, 3),
+            "sharded_speedup": round(med_b / med_a, 3) if med_a else None,
+            "consume_workers": LANES,
+            "cores": os.cpu_count() or 1,
+            "consume_roofline_gbps": round(roofline, 3),
+            "roofline_fraction": (
+                round(med_b / roofline, 3) if roofline else None
+            ),
+        }
+        buf.free()
+    finally:
+        cli_i.stop()
+        cli_s.stop()
+        srv.stop()
+    return out
+
+
 def bench_device_fetch_ab(dry_run: bool = False) -> dict:
     """Interleaved device-pull vs host-fetch A/B pairs, SAME run.
 
@@ -937,7 +1240,18 @@ def bench_concurrent_jobs_ab(dry_run: bool = False) -> dict:
     med_b = float(np.median([p["concurrent_mbps"] for p in pairs]))
     speedup = round(med_b / med_a, 3) if med_a else None
     cores = os.cpu_count() or 1
-    if cores >= 4 and speedup is not None and speedup < 1.5:
+    # the ≥1.5x gate only MEANS anything where parallelism exists;
+    # everywhere this row is checked (CI smoke included) the consumer
+    # must branch on gate_evaluated and surface gate_skip_reason
+    # loudly instead of silently passing on a small rig
+    gate_evaluated = cores >= 4 and speedup is not None
+    gate_skip_reason = None
+    if not gate_evaluated:
+        gate_skip_reason = (
+            f"only {cores} core(s): concurrency gate needs >= 4"
+            if cores < 4 else "no speedup measured"
+        )
+    if gate_evaluated and speedup < 1.5:
         raise SystemExit(
             f"BENCH FAILED: concurrent serving {speedup}x < 1.5x on a "
             f"{cores}-core rig"
@@ -949,6 +1263,8 @@ def bench_concurrent_jobs_ab(dry_run: bool = False) -> dict:
         "concurrency_speedup": speedup,
         "jobs": n_jobs,
         "cores": cores,
+        "gate_evaluated": gate_evaluated,
+        "gate_skip_reason": gate_skip_reason,
     }
     return out
 
@@ -1270,18 +1586,20 @@ def main() -> None:
     parser.add_argument(
         "--ab",
         default="",
-        choices=["", "device_fetch", "concurrent_jobs"],
+        choices=["", "device_fetch", "concurrent_jobs", "iouring_read",
+                 "consume_sharded"],
         help="run ONE A/B at reduced volume and print its JSON — the CI "
         "obs smoke's dry-run mode (e.g. --ab device_fetch)",
     )
     args = parser.parse_args()
-    if args.ab == "device_fetch":
-        record = bench_device_fetch_ab(dry_run=True)
-        record["dry_run"] = True
-        print(json.dumps(record))
-        return
-    if args.ab == "concurrent_jobs":
-        record = bench_concurrent_jobs_ab(dry_run=True)
+    dry_abs = {
+        "device_fetch": bench_device_fetch_ab,
+        "concurrent_jobs": bench_concurrent_jobs_ab,
+        "iouring_read": bench_iouring_read_ab,
+        "consume_sharded": bench_consume_sharded_ab,
+    }
+    if args.ab:
+        record = dry_abs[args.ab](dry_run=True)
         record["dry_run"] = True
         print(json.dumps(record))
         return
@@ -1304,6 +1622,8 @@ def main() -> None:
     out.update(bench_consume_pipelined_ab())
     out.update(bench_consume_mapped_ab())
     out.update(bench_striping_ab())
+    out.update(bench_iouring_read_ab())
+    out.update(bench_consume_sharded_ab())
     out.update(bench_device_fetch_ab())
     out.update(bench_concurrent_jobs_ab())
     import jax

@@ -65,6 +65,18 @@
 #include <unordered_map>
 #include <vector>
 
+// io_uring backend: raw syscalls against <linux/io_uring.h> so no
+// liburing dependency is ever required. Compiled out when the uapi
+// header is missing or SRT_NO_IOURING is defined (the CI no-liburing
+// matrix leg); availability on the RUNNING kernel is a separate
+// runtime probe that latches ENOSYS/EPERM into a pread fallback.
+#if defined(__linux__) && !defined(SRT_NO_IOURING) && \
+    __has_include(<linux/io_uring.h>)
+#define SRT_HAVE_IOURING 1
+#include <linux/io_uring.h>
+#include <sys/syscall.h>
+#endif
+
 namespace {
 
 // A pthread_cond_timedwait that TIMES OUT corrupts this toolchain's
@@ -318,6 +330,92 @@ struct FileTask {
   std::shared_ptr<TaskGroup> group;  // non-null: one part of a split task
 };
 
+// one resolved read descriptor: a contiguous run of one validated file
+// (fd already identity-checked) landing in a contiguous destination.
+// `lens` keeps the block boundaries inside the run so the preadv2
+// scatter backend can submit them as iovecs; io_uring submits the run
+// as one SQE (the destination is contiguous across the run anyway).
+struct ReadSqe {
+  int fd = -1;
+  uint64_t off = 0;
+  uint8_t* dst = nullptr;
+  std::vector<uint64_t> lens;
+  uint64_t total = 0;
+};
+
+// read-backend knob values (mirrors tpu.shuffle.native.readBackend)
+enum { RB_AUTO = 0, RB_IOURING = 1, RB_PREAD = 2, RB_MAPPED = 3 };
+
+// SubmissionPlane: the single seam every same-host file read goes
+// through. The loop thread enqueues logical read requests via
+// plane_submit (which owns the striping/splitting policy that used to
+// live inline in the READF_BODY frame handler); file workers drain
+// them via plane_execute, which resolves blocks into ReadSqe runs and
+// hands the runs to ONE of the interchangeable backends:
+//
+//   backend      submit path                    degradation
+//   io_uring     batched SQEs, READ_FIXED when  ENOSYS/EPERM/old kernel
+//                dst is inside a registered     -> pread (latched once,
+//                segment snapshot               backend_fallbacks++);
+//                                               short/failed CQE ->
+//                                               per-run pread
+//   pread        preadv2 scatter per run        ENOSYS -> pread loop
+//   mapped-copy  mmap(MAP_POPULATE)+memcpy      mmap failure -> pread
+//
+// Mapped DELIVERY (records handed to the consumer in place, aux=1
+// completions) is a completion mode, not a backend: plane_execute
+// routes it internally, so no caller ever branches on
+// pread-vs-mapped-vs-scatter.
+//
+// Fixed buffers: srt_reg/srt_reg_file record writable registered
+// segments here; each worker's ring snapshots the list ONCE at ring
+// creation and registers it via IORING_REGISTER_BUFFERS. Deregistering
+// a recorded segment bumps seg_dead_gen, which disables READ_FIXED on
+// every ring built against an older snapshot (plain IORING_OP_READ
+// still flows) — a freed slab can never be written through a stale
+// buf_index.
+struct SubmissionPlane {
+  std::atomic<int> backend{RB_AUTO};
+  // io_uring availability: 0 unknown, 1 available, -1 unavailable
+  // (probe failed), -2 forced unavailable (test seam)
+  std::atomic<int> uring_state{0};
+  std::atomic<int> force_probe_fail{0};
+  // observable submission-queue accounting (transport.sq.* families)
+  std::atomic<uint64_t> sq_submits{0};
+  std::atomic<uint64_t> sq_batches{0};
+  std::atomic<uint64_t> sq_depth_hwm{0};
+  std::atomic<uint64_t> sq_completions{0};
+  std::atomic<uint64_t> sq_backend_fallbacks{0};
+  // fixed-buffer candidates: registered segments whose memory is
+  // writable for the process lifetime of the rings built on them
+  std::mutex seg_mu;
+  std::vector<std::pair<uint64_t, uint64_t>> segs;
+  std::atomic<uint64_t> seg_dead_gen{0};
+
+  void add_segment(const void* ptr, uint64_t len) {
+    if (!ptr || !len) return;
+    std::lock_guard<std::mutex> g(seg_mu);
+    // IORING_REGISTER_BUFFERS caps the iovec table at 1024 entries
+    if (segs.size() >= 1024) return;
+    segs.emplace_back((uint64_t)ptr, len);
+  }
+  void remove_segment(const void* ptr) {
+    std::lock_guard<std::mutex> g(seg_mu);
+    for (auto it = segs.begin(); it != segs.end(); ++it) {
+      if (it->first == (uint64_t)ptr) {
+        segs.erase(it);
+        seg_dead_gen.fetch_add(1, std::memory_order_release);
+        return;
+      }
+    }
+  }
+  void note_depth(uint64_t d) {
+    uint64_t cur = sq_depth_hwm.load(std::memory_order_relaxed);
+    while (d > cur && !sq_depth_hwm.compare_exchange_weak(cur, d)) {
+    }
+  }
+};
+
 struct Node {
   int listen_fd = -1;
   int epfd = -1;
@@ -411,6 +509,10 @@ struct Node {
   std::condition_variable ft_cv;
   std::deque<FileTask> ftq;
   std::map<std::pair<uint64_t, uint64_t>, PendingRead> file_pending;
+
+  // the read submission plane (backend choice, SQ stats, fixed-buffer
+  // segment registry) — see the SubmissionPlane comment
+  SubmissionPlane plane;
 
   void post(Completion c) {
     {
@@ -888,8 +990,13 @@ static bool read_run_scatter(int fd, uint64_t off, uint8_t* dst,
   return true;
 }
 
-bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
-  if (t.mapped) return do_file_task_mapped(t);
+// resolve a FileTask's (path, identity, off, len) blocks into coalesced
+// contiguous runs with validated fds — shared by EVERY backend, so the
+// identity checks and the run coalescing can never diverge between
+// them. fds stay owned by the worker's fd_cache; descriptors borrow.
+static bool resolve_runs(FileTask& t,
+                         std::unordered_map<std::string, int>& fd_cache,
+                         std::vector<ReadSqe>& out) {
   uint64_t dst_off = 0;
   for (size_t i = 0; i < t.files.size(); i++) {
     uint64_t len = t.lens[i];
@@ -926,28 +1033,403 @@ bool do_file_task(FileTask& t, std::unordered_map<std::string, int>& fd_cache) {
     }
     // coalesce the contiguous run starting at i — same inode, offsets
     // back-to-back (a reducer's adjacent partition chunks in one spill
-    // file) — into one scatter read instead of one pread per block
-    std::vector<uint64_t> run_lens{len};
-    uint64_t run_total = len;
+    // file) — into one descriptor instead of one per block
+    ReadSqe s;
+    s.fd = fd;
+    s.off = f.off;
+    s.dst = t.dst + dst_off;
+    s.lens.push_back(len);
+    s.total = len;
     size_t j = i + 1;
     while (j < t.files.size() && t.files[j].path == f.path &&
            t.files[j].dev == f.dev && t.files[j].ino == f.ino &&
-           t.files[j].off == f.off + run_total) {
-      run_lens.push_back(t.lens[j]);
-      run_total += t.lens[j];
+           t.files[j].off == f.off + s.total) {
+      s.lens.push_back(t.lens[j]);
+      s.total += t.lens[j];
       j++;
     }
-    if (!read_run_scatter(fd, f.off, t.dst + dst_off, run_lens.data(),
-                          run_lens.size()))
-      return false;
-    dst_off += run_total;
+    dst_off += s.total;
+    out.push_back(std::move(s));
     i = j - 1;
+  }
+  return true;
+}
+
+// mapped-COPY backend: mmap the run's file window and memcpy into the
+// destination — the same page-cache bytes as pread through a different
+// kernel path (page-table walk instead of a read syscall per run).
+// Distinct from mapped DELIVERY, which hands the mapping itself to the
+// consumer. mmap refusal degrades to pread in the caller.
+static bool sqe_mapped_copy(const ReadSqe& s) {
+  size_t page = (size_t)sysconf(_SC_PAGESIZE);
+  uint64_t aligned = s.off & ~(uint64_t)(page - 1);
+  uint64_t delta = s.off - aligned;
+  size_t map_len = (size_t)(s.total + delta);
+  int flags = MAP_SHARED;
+#ifdef MAP_POPULATE
+  flags |= MAP_POPULATE;
+#endif
+  void* base = mmap(nullptr, map_len, PROT_READ, flags, s.fd, (off_t)aligned);
+#ifdef MAP_POPULATE
+  if (base == MAP_FAILED)
+    base = mmap(nullptr, map_len, PROT_READ, MAP_SHARED, s.fd, (off_t)aligned);
+#endif
+  if (base == MAP_FAILED) return false;
+  memcpy(s.dst, (const uint8_t*)base + delta, (size_t)s.total);
+  munmap(base, map_len);
+  return true;
+}
+
+#ifdef SRT_HAVE_IOURING
+static int sys_io_uring_setup(unsigned entries, struct io_uring_params* p) {
+  return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+static int sys_io_uring_enter(int fd, unsigned to_submit,
+                              unsigned min_complete, unsigned flags) {
+  return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags,
+                      nullptr, 0);
+}
+static int sys_io_uring_register(int fd, unsigned opcode, const void* arg,
+                                 unsigned nr_args) {
+  return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
+}
+
+// One ring per file-worker thread: single submitter by construction,
+// so no ring locking anywhere. Created lazily on the worker's first
+// uring-backed task, torn down when the worker exits. SQPOLL is
+// deliberately NOT requested — it needs privileges/5.13+ for unpinned
+// use and burns a core busy-polling, which the consume lanes want.
+struct UringRing {
+  int ring_fd = -1;
+  unsigned entries = 0;
+  uint8_t* sq_ring = nullptr;
+  size_t sq_ring_len = 0;
+  uint8_t* cq_ring = nullptr;
+  size_t cq_ring_len = 0;
+  struct io_uring_sqe* sqes = nullptr;
+  size_t sqes_len = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+  struct io_uring_cqe* cqes = nullptr;
+  // fixed-buffer snapshot: registered ONCE at ring creation; READ_FIXED
+  // is used only while plane.seg_dead_gen still matches dead_gen
+  bool fixed_ok = false;
+  uint64_t dead_gen = 0;
+  std::vector<std::pair<uint64_t, uint64_t>> bufs;
+  bool ready = false;
+
+  void destroy() {
+    if (sqes) munmap(sqes, sqes_len);
+    if (cq_ring && cq_ring != sq_ring) munmap(cq_ring, cq_ring_len);
+    if (sq_ring) munmap(sq_ring, sq_ring_len);
+    if (ring_fd >= 0) close(ring_fd);
+    sqes = nullptr;
+    cq_ring = nullptr;
+    sq_ring = nullptr;
+    ring_fd = -1;
+    ready = false;
+  }
+  ~UringRing() { destroy(); }
+};
+
+static bool uring_init(UringRing& r, SubmissionPlane& plane) {
+  struct io_uring_params p;
+  memset(&p, 0, sizeof(p));
+  int fd = sys_io_uring_setup(64, &p);
+  if (fd < 0) return false;
+  r.ring_fd = fd;
+  r.entries = p.sq_entries;
+  size_t sq_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+  size_t cq_len = p.cq_off.cqes + p.cq_entries * sizeof(struct io_uring_cqe);
+  bool single = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+  if (single) sq_len = cq_len = std::max(sq_len, cq_len);
+  void* sq = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                  IORING_OFF_SQ_RING);
+  if (sq == MAP_FAILED) {
+    r.destroy();
+    return false;
+  }
+  r.sq_ring = (uint8_t*)sq;
+  r.sq_ring_len = sq_len;
+  if (single) {
+    r.cq_ring = r.sq_ring;
+    r.cq_ring_len = sq_len;
+  } else {
+    void* cq = mmap(nullptr, cq_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                    IORING_OFF_CQ_RING);
+    if (cq == MAP_FAILED) {
+      r.destroy();
+      return false;
+    }
+    r.cq_ring = (uint8_t*)cq;
+    r.cq_ring_len = cq_len;
+  }
+  r.sqes_len = p.sq_entries * sizeof(struct io_uring_sqe);
+  void* se = mmap(nullptr, r.sqes_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                  IORING_OFF_SQES);
+  if (se == MAP_FAILED) {
+    r.destroy();
+    return false;
+  }
+  r.sqes = (struct io_uring_sqe*)se;
+  r.sq_head = (unsigned*)(r.sq_ring + p.sq_off.head);
+  r.sq_tail = (unsigned*)(r.sq_ring + p.sq_off.tail);
+  r.sq_mask = (unsigned*)(r.sq_ring + p.sq_off.ring_mask);
+  r.sq_array = (unsigned*)(r.sq_ring + p.sq_off.array);
+  r.cq_head = (unsigned*)(r.cq_ring + p.cq_off.head);
+  r.cq_tail = (unsigned*)(r.cq_ring + p.cq_off.tail);
+  r.cq_mask = (unsigned*)(r.cq_ring + p.cq_off.ring_mask);
+  r.cqes = (struct io_uring_cqe*)(r.cq_ring + p.cq_off.cqes);
+  // fixed-buffer registration: ONE snapshot, ONE register call, for
+  // the ring's whole lifetime. Registration pins the pages, so a slab
+  // deregistered later stays resident until the ring closes — the
+  // dead_gen check above only stops NEW READ_FIXED submissions from
+  // addressing it. Failure (RLIMIT_MEMLOCK, unmappable segment)
+  // degrades to plain IORING_OP_READ; never fatal.
+  {
+    std::lock_guard<std::mutex> g(plane.seg_mu);
+    r.bufs = plane.segs;
+  }
+  r.dead_gen = plane.seg_dead_gen.load(std::memory_order_acquire);
+  if (!r.bufs.empty()) {
+    std::vector<struct iovec> iov;
+    bool fits = true;
+    for (auto& s : r.bufs) {
+      if (s.second > (1ull << 30)) {  // kernel per-iovec cap
+        fits = false;
+        break;
+      }
+      iov.push_back({(void*)s.first, (size_t)s.second});
+    }
+    if (fits && !iov.empty() &&
+        sys_io_uring_register(fd, IORING_REGISTER_BUFFERS, iov.data(),
+                              (unsigned)iov.size()) == 0)
+      r.fixed_ok = true;
+    if (!r.fixed_ok) r.bufs.clear();
+  }
+  r.ready = true;
+  return true;
+}
+
+// submit the resolved runs as batched SQEs and reap their CQEs. One
+// SQE per run (the destination is contiguous across a run); batches
+// bounded by the ring size. Short or failed CQEs are finished by the
+// pread scatter path per run — bytes identical, counted as fallbacks.
+static bool uring_exec(SubmissionPlane& pl, UringRing& r,
+                       const std::vector<ReadSqe>& rs) {
+  bool fixed_usable =
+      r.fixed_ok &&
+      pl.seg_dead_gen.load(std::memory_order_acquire) == r.dead_gen;
+  std::vector<uint64_t> got(rs.size(), 0);
+  size_t done = 0;
+  while (done < rs.size()) {
+    unsigned batch = (unsigned)std::min((size_t)r.entries, rs.size() - done);
+    unsigned tail = *r.sq_tail;
+    for (unsigned k = 0; k < batch; k++) {
+      const ReadSqe& s = rs[done + k];
+      unsigned idx = (tail + k) & *r.sq_mask;
+      struct io_uring_sqe* e = &r.sqes[idx];
+      memset(e, 0, sizeof(*e));
+      e->fd = s.fd;
+      e->addr = (uint64_t)s.dst;
+      // sqe.len is 32-bit: cap the request; a capped (short) read is
+      // completed by the pread fallback below
+      e->len = (uint32_t)std::min<uint64_t>(s.total, 1u << 30);
+      e->off = s.off;
+      e->user_data = done + k;
+      int bi = -1;
+      if (fixed_usable) {
+        for (size_t b = 0; b < r.bufs.size(); b++) {
+          uint64_t lo = r.bufs[b].first;
+          uint64_t hi = lo + r.bufs[b].second;
+          if ((uint64_t)s.dst >= lo && (uint64_t)s.dst + s.total <= hi) {
+            bi = (int)b;
+            break;
+          }
+        }
+      }
+      if (bi >= 0) {
+        e->opcode = IORING_OP_READ_FIXED;
+        e->buf_index = (uint16_t)bi;
+      } else {
+        e->opcode = IORING_OP_READ;
+      }
+      r.sq_array[idx] = idx;
+    }
+    __atomic_store_n(r.sq_tail, tail + batch, __ATOMIC_RELEASE);
+    pl.sq_submits.fetch_add(batch, std::memory_order_relaxed);
+    pl.note_depth(batch);
+    unsigned submitted = 0;
+    while (submitted < batch) {
+      int ret = sys_io_uring_enter(r.ring_fd, batch - submitted,
+                                   batch - submitted, IORING_ENTER_GETEVENTS);
+      if (ret < 0) {
+        if (errno == EINTR) continue;
+        return false;  // ring wedged: caller degrades the whole task
+      }
+      submitted += (unsigned)ret;
+    }
+    pl.sq_batches.fetch_add(1, std::memory_order_relaxed);
+    unsigned head = *r.cq_head;
+    unsigned reaped = 0;
+    while (reaped < batch) {
+      unsigned ctail = __atomic_load_n(r.cq_tail, __ATOMIC_ACQUIRE);
+      if (head == ctail) {
+        int ret = sys_io_uring_enter(r.ring_fd, 0, 1, IORING_ENTER_GETEVENTS);
+        if (ret < 0 && errno != EINTR) return false;
+        continue;
+      }
+      while (head != ctail && reaped < batch) {
+        struct io_uring_cqe* cqe = &r.cqes[head & *r.cq_mask];
+        uint64_t ud = cqe->user_data;
+        if (cqe->res > 0 && ud < rs.size()) got[ud] = (uint64_t)cqe->res;
+        head++;
+        reaped++;
+      }
+      __atomic_store_n(r.cq_head, head, __ATOMIC_RELEASE);
+    }
+    done += batch;
+  }
+  for (size_t i = 0; i < rs.size(); i++) {
+    const ReadSqe& s = rs[i];
+    if (got[i] < s.total) {
+      // short or failed: redo the run via the scatter path (the rare
+      // path re-reads a prefix; correctness over cleverness here)
+      pl.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+      if (!read_run_scatter(s.fd, s.off, s.dst, s.lens.data(),
+                            s.lens.size()))
+        return false;
+    }
+    pl.sq_completions.fetch_add(1, std::memory_order_relaxed);
+  }
+  return true;
+}
+#endif  // SRT_HAVE_IOURING
+
+// per-worker backend state: the lazily-created ring (when compiled in)
+struct WorkerRing {
+#ifdef SRT_HAVE_IOURING
+  UringRing ring;
+#endif
+  bool tried = false;
+  bool counted_fail = false;
+};
+
+// availability probe, latched once per node: can this kernel do
+// io_uring at all? The force_probe_fail seam makes the probe behave
+// exactly like an ENOSYS kernel (tests + the read:enosys fault kind).
+static bool plane_uring_probe(SubmissionPlane& pl) {
+#ifndef SRT_HAVE_IOURING
+  int st = pl.uring_state.load(std::memory_order_relaxed);
+  if (st == 0 && pl.uring_state.compare_exchange_strong(st, -1))
+    pl.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+  return false;
+#else
+  if (pl.force_probe_fail.load(std::memory_order_relaxed)) {
+    int st = pl.uring_state.load(std::memory_order_relaxed);
+    if (st != -2 && pl.uring_state.compare_exchange_strong(st, -2))
+      pl.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  int st = pl.uring_state.load(std::memory_order_relaxed);
+  if (st < 0) return false;
+  if (st == 0) {
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    int fd = sys_io_uring_setup(4, &p);
+    int now = fd >= 0 ? 1 : -1;
+    if (fd >= 0) close(fd);
+    int expect = 0;
+    if (pl.uring_state.compare_exchange_strong(expect, now)) {
+      if (now < 0)
+        pl.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    }
+    return pl.uring_state.load(std::memory_order_relaxed) == 1;
+  }
+  return true;
+#endif
+}
+
+static bool plane_uring_ready(Node* n, WorkerRing& wr) {
+  if (!plane_uring_probe(n->plane)) return false;
+#ifndef SRT_HAVE_IOURING
+  (void)wr;
+  return false;
+#else
+  if (!wr.tried) {
+    wr.tried = true;
+    uring_init(wr.ring, n->plane);
+  }
+  if (!wr.ring.ready && !wr.counted_fail) {
+    // the node-level probe passed but THIS worker's ring failed
+    // (fd/memlock limits): this worker degrades to pread, once
+    wr.counted_fail = true;
+    n->plane.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+  }
+  return wr.ring.ready;
+#endif
+}
+
+// SubmissionPlane execution, worker-thread side: resolve the task's
+// blocks into runs and drive them through the selected backend. THE
+// place where pread-vs-mapped-vs-scatter-vs-uring is decided — no
+// caller branches on it.
+bool plane_execute(Node* n, FileTask& t,
+                   std::unordered_map<std::string, int>& fd_cache,
+                   WorkerRing& wr) {
+  SubmissionPlane& pl = n->plane;
+  if (t.mapped) {
+    // mapped DELIVERY: completion mode, not a backend (see plane doc)
+    pl.sq_batches.fetch_add(1, std::memory_order_relaxed);
+    pl.sq_submits.fetch_add(t.files.size(), std::memory_order_relaxed);
+    pl.note_depth(t.files.size());
+    if (!do_file_task_mapped(t)) return false;
+    pl.sq_completions.fetch_add(t.files.size(), std::memory_order_relaxed);
+    return true;
+  }
+  std::vector<ReadSqe> runs;
+  if (!resolve_runs(t, fd_cache, runs)) return false;
+  int want = pl.backend.load(std::memory_order_relaxed);
+  if (want == RB_AUTO || want == RB_IOURING) {
+#ifdef SRT_HAVE_IOURING
+    if (plane_uring_ready(n, wr)) {
+      if (uring_exec(pl, wr.ring, runs)) return true;
+      // wedged ring mid-task: count and degrade this task to pread
+      pl.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    }
+#else
+    plane_uring_ready(n, wr);  // latches the fallback, counted once
+#endif
+  }
+  bool mapped_copy = want == RB_MAPPED;
+  pl.sq_batches.fetch_add(1, std::memory_order_relaxed);
+  pl.note_depth(runs.size());
+  for (auto& s : runs) {
+    pl.sq_submits.fetch_add(1, std::memory_order_relaxed);
+    bool ok = mapped_copy
+                  ? sqe_mapped_copy(s)
+                  : read_run_scatter(s.fd, s.off, s.dst, s.lens.data(),
+                                     s.lens.size());
+    if (!ok && mapped_copy) {
+      // filesystem refused the mapping: degrade the run to pread
+      pl.sq_backend_fallbacks.fetch_add(1, std::memory_order_relaxed);
+      ok = read_run_scatter(s.fd, s.off, s.dst, s.lens.data(),
+                            s.lens.size());
+    }
+    if (!ok) return false;
+    pl.sq_completions.fetch_add(1, std::memory_order_relaxed);
   }
   return true;
 }
 
 void file_worker_main(Node* n) {
   std::unordered_map<std::string, int> fd_cache;
+  WorkerRing ring;
   while (true) {
     FileTask t;
     {
@@ -957,7 +1439,7 @@ void file_worker_main(Node* n) {
       t = std::move(n->ftq.front());
       n->ftq.pop_front();
     }
-    bool ok = do_file_task(t, fd_cache);
+    bool ok = plane_execute(n, t, fd_cache, ring);
     if (t.group) {
       // one part of a split task: only the LAST finisher completes
       // the request (success only if every part succeeded)
@@ -973,6 +1455,116 @@ void file_worker_main(Node* n) {
     n->enqueue(std::move(cmd));
   }
   for (auto& kv : fd_cache) close(kv.second);
+}
+
+// SubmissionPlane entry point, loop-thread side: schedule one logical
+// read request (already parked in file_pending) onto the worker pool.
+// The striping/splitting policy lives HERE — behind the plane seam —
+// not in the frame handler, so every backend composes with it.
+void plane_submit(Node* n, FileTask&& t) {
+  // multi-block pread tasks fan out over the worker pool (the
+  // WR-list striping analogue): contiguous block ranges, each
+  // part's dst pre-offset, one shared completion. Mapped tasks
+  // stay whole (their records must keep request order). The pool
+  // can grow mid-run (srt_set_file_workers), so read the atomic
+  // count — never the vector, which mutates under fw_mu.
+  size_t nworkers = n->file_worker_count.load(std::memory_order_acquire);
+  uint64_t total_bytes = 0;
+  for (uint64_t L : t.lens) total_bytes += L;
+  // intra-block striping: a single fat block (the common
+  // one-partition fetch) would otherwise ride one worker while
+  // the rest of the pool idles. Expand any block >= 4MB into
+  // contiguous sub-ranges of the SAME file (offset advanced,
+  // identity fields unchanged) so the byte-balanced split below
+  // can spread ONE block across file_workers threads. Only for
+  // the pread path: dst placement is cumulative over lens, so
+  // sub-block boundaries are invisible downstream; mapped tasks
+  // keep per-block records and must stay whole.
+  if (!t.mapped && nworkers > 1) {
+    std::vector<FileRef> xfiles;
+    std::vector<uint64_t> xlens;
+    for (size_t i = 0; i < t.files.size(); i++) {
+      uint64_t blen = t.lens[i];
+      // each sub-range stays >= 1MB so the stripe never degrades
+      // into syscall-overhead-dominated slivers
+      size_t sparts = (size_t)std::min<uint64_t>(
+          (uint64_t)nworkers, blen / (1ull << 20));
+      if (blen >= (4ull << 20) && sparts > 1) {
+        uint64_t chunk = (blen + sparts - 1) / sparts;
+        for (uint64_t done = 0; done < blen; done += chunk) {
+          FileRef sub = t.files[i];
+          sub.off += done;
+          xfiles.push_back(std::move(sub));
+          xlens.push_back(std::min(chunk, blen - done));
+        }
+        n->stat_block_stripes.fetch_add((blen + chunk - 1) / chunk);
+        continue;
+      }
+      xfiles.push_back(std::move(t.files[i]));
+      xlens.push_back(blen);
+    }
+    t.files = std::move(xfiles);
+    t.lens = std::move(xlens);
+  }
+  // split only when the work amortizes the dispatch (a few MB
+  // floor) and balance parts by BYTES, not block count — one fat
+  // block among small ones must not leave a part doing all the
+  // copying while the others pay pure thread overhead
+  if (!t.mapped && nworkers > 1 && t.files.size() > 1 &&
+      total_bytes >= (4ull << 20)) {
+    size_t parts = std::min(nworkers, t.files.size());
+    auto grp = std::make_shared<TaskGroup>();
+    std::vector<FileTask> subs;
+    uint64_t off = 0, acc = 0, remaining_bytes = total_bytes;
+    FileTask s;
+    s.channel = t.channel;
+    s.req_id = t.req_id;
+    s.group = grp;
+    s.dst = t.dst;
+    for (size_t i = 0; i < t.files.size(); i++) {
+      s.files.push_back(std::move(t.files[i]));
+      s.lens.push_back(t.lens[i]);
+      acc += t.lens[i];
+      off += t.lens[i];
+      remaining_bytes -= t.lens[i];
+      bool more_parts = subs.size() + 1 < parts;
+      bool more_files = i + 1 < t.files.size();
+      if (more_parts && more_files) {
+        // close this part when stopping NOW lands closer to its
+        // fair share (remaining bytes / remaining parts) than
+        // absorbing the next block would — keeps parts byte-
+        // balanced even when one fat block sits among small ones
+        uint64_t share = (acc + remaining_bytes) / (parts - subs.size());
+        uint64_t next = t.lens[i + 1];
+        uint64_t over = acc + next > share ? acc + next - share : 0;
+        uint64_t under = share > acc ? share - acc : 0;
+        if (acc >= share || over > under) {
+          subs.push_back(std::move(s));
+          s = FileTask();
+          s.channel = t.channel;
+          s.req_id = t.req_id;
+          s.group = grp;
+          s.dst = t.dst + off;
+          acc = 0;
+        }
+      }
+    }
+    subs.push_back(std::move(s));
+    // set the count BEFORE any part is enqueued
+    grp->remaining.store((int)subs.size());
+    n->stat_split_parts.fetch_add(subs.size());
+    {
+      std::lock_guard<std::mutex> g(n->ft_mu);
+      for (auto& sub : subs) n->ftq.push_back(std::move(sub));
+    }
+    n->ft_cv.notify_all();
+  } else {
+    {
+      std::lock_guard<std::mutex> g(n->ft_mu);
+      n->ftq.push_back(std::move(t));
+    }
+    n->ft_cv.notify_one();
+  }
 }
 
 void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len);
@@ -1275,111 +1867,9 @@ void handle_frame_ingest(Node* n, Conn* c, const uint8_t* data, size_t len) {
         n->file_pending.emplace(std::make_pair(c->id, c->cur_req),
                                 std::move(it->second));
         c->reads.erase(it);
-        // multi-block pread tasks fan out over the worker pool (the
-        // WR-list striping analogue): contiguous block ranges, each
-        // part's dst pre-offset, one shared completion. Mapped tasks
-        // stay whole (their records must keep request order). The pool
-        // can grow mid-run (srt_set_file_workers), so read the atomic
-        // count — never the vector, which mutates under fw_mu.
-        size_t nworkers = n->file_worker_count.load(std::memory_order_acquire);
-        uint64_t total_bytes = 0;
-        for (uint64_t L : t.lens) total_bytes += L;
-        // intra-block striping: a single fat block (the common
-        // one-partition fetch) would otherwise ride one worker while
-        // the rest of the pool idles. Expand any block >= 4MB into
-        // contiguous sub-ranges of the SAME file (offset advanced,
-        // identity fields unchanged) so the byte-balanced split below
-        // can spread ONE block across file_workers threads. Only for
-        // the pread path: dst placement is cumulative over lens, so
-        // sub-block boundaries are invisible downstream; mapped tasks
-        // keep per-block records and must stay whole.
-        if (!t.mapped && nworkers > 1) {
-          std::vector<FileRef> xfiles;
-          std::vector<uint64_t> xlens;
-          for (size_t i = 0; i < t.files.size(); i++) {
-            uint64_t blen = t.lens[i];
-            // each sub-range stays >= 1MB so the stripe never degrades
-            // into syscall-overhead-dominated slivers
-            size_t sparts = (size_t)std::min<uint64_t>(
-                (uint64_t)nworkers, blen / (1ull << 20));
-            if (blen >= (4ull << 20) && sparts > 1) {
-              uint64_t chunk = (blen + sparts - 1) / sparts;
-              for (uint64_t done = 0; done < blen; done += chunk) {
-                FileRef sub = t.files[i];
-                sub.off += done;
-                xfiles.push_back(std::move(sub));
-                xlens.push_back(std::min(chunk, blen - done));
-              }
-              n->stat_block_stripes.fetch_add(
-                  (blen + chunk - 1) / chunk);
-              continue;
-            }
-            xfiles.push_back(std::move(t.files[i]));
-            xlens.push_back(blen);
-          }
-          t.files = std::move(xfiles);
-          t.lens = std::move(xlens);
-        }
-        // split only when the work amortizes the dispatch (a few MB
-        // floor) and balance parts by BYTES, not block count — one fat
-        // block among small ones must not leave a part doing all the
-        // copying while the others pay pure thread overhead
-        if (!t.mapped && nworkers > 1 && t.files.size() > 1 &&
-            total_bytes >= (4ull << 20)) {
-          size_t parts = std::min(nworkers, t.files.size());
-          auto grp = std::make_shared<TaskGroup>();
-          std::vector<FileTask> subs;
-          uint64_t off = 0, acc = 0, remaining_bytes = total_bytes;
-          FileTask s;
-          s.channel = t.channel;
-          s.req_id = t.req_id;
-          s.group = grp;
-          s.dst = t.dst;
-          for (size_t i = 0; i < t.files.size(); i++) {
-            s.files.push_back(std::move(t.files[i]));
-            s.lens.push_back(t.lens[i]);
-            acc += t.lens[i];
-            off += t.lens[i];
-            remaining_bytes -= t.lens[i];
-            bool more_parts = subs.size() + 1 < parts;
-            bool more_files = i + 1 < t.files.size();
-            if (more_parts && more_files) {
-              // close this part when stopping NOW lands closer to its
-              // fair share (remaining bytes / remaining parts) than
-              // absorbing the next block would — keeps parts byte-
-              // balanced even when one fat block sits among small ones
-              uint64_t share =
-                  (acc + remaining_bytes) / (parts - subs.size());
-              uint64_t next = t.lens[i + 1];
-              uint64_t over = acc + next > share ? acc + next - share : 0;
-              uint64_t under = share > acc ? share - acc : 0;
-              if (acc >= share || over > under) {
-                subs.push_back(std::move(s));
-                s = FileTask();
-                s.channel = t.channel;
-                s.req_id = t.req_id;
-                s.group = grp;
-                s.dst = t.dst + off;
-                acc = 0;
-              }
-            }
-          }
-          subs.push_back(std::move(s));
-          // set the count BEFORE any part is enqueued
-          grp->remaining.store((int)subs.size());
-          n->stat_split_parts.fetch_add(subs.size());
-          {
-            std::lock_guard<std::mutex> g(n->ft_mu);
-            for (auto& s : subs) n->ftq.push_back(std::move(s));
-          }
-          n->ft_cv.notify_all();
-        } else {
-          {
-            std::lock_guard<std::mutex> g(n->ft_mu);
-            n->ftq.push_back(std::move(t));
-          }
-          n->ft_cv.notify_one();
-        }
+        // hand the request to the submission plane: striping,
+        // splitting and backend choice all live behind that one seam
+        plane_submit(n, std::move(t));
       } else {
         // different host (proof unreachable): latch the fast path off
         // for this conn. A malformed frame just streams this one read.
@@ -1796,6 +2286,9 @@ uint32_t srt_reg(void* np, const void* ptr, uint64_t len) {
   r.ptr = (const uint8_t*)ptr;
   r.len = len;
   n->regions[mkey] = r;
+  // plain registrations are caller-writable memory: fixed-buffer
+  // candidates for io_uring rings created after this point
+  n->plane.add_segment(ptr, len);
   return mkey;
 }
 
@@ -1829,6 +2322,12 @@ uint32_t srt_reg_file(void* np, const void* ptr, uint64_t len,
     r.file_mtime_ns = mtime_ns;
   }
   n->regions[mkey] = r;
+  // only MUTABLE backings (shm slabs: the mempool's segments, mapped
+  // read-write) are fixed-buffer candidates — immutable spill-file
+  // registrations are typically read-only mappings, and one unwritable
+  // iovec fails the whole IORING_REGISTER_BUFFERS call
+  if (!r.file_backed || (size == 0 && mtime_ns == 0))
+    n->plane.add_segment(ptr, len);
   return mkey;
 }
 
@@ -1837,6 +2336,10 @@ int srt_dereg(void* np, uint32_t mkey) {
   std::unique_lock<std::mutex> lk(n->reg_mu);
   auto it = n->regions.find(mkey);
   if (it == n->regions.end()) return -1;
+  // drop the fixed-buffer candidate NOW (the caller intends to free
+  // the memory): bumping seg_dead_gen stops every ring built on an
+  // older snapshot from submitting READ_FIXED against it
+  n->plane.remove_segment(it->second.ptr);
   if (it->second.pins == 0) {
     n->regions.erase(it);
     return 0;
@@ -1888,6 +2391,64 @@ uint64_t srt_stat_block_stripes(void* np) {
 }
 uint64_t srt_stat_split_parts(void* np) {
   return ((Node*)np)->stat_split_parts.load();
+}
+
+// -- submission plane ---------------------------------------------------
+// read backend knob (tpu.shuffle.native.readBackend): 0 auto (io_uring
+// when the kernel has it, else pread), 1 io_uring (degrades to pread
+// when unavailable), 2 pread/preadv2, 3 mapped-copy
+void srt_set_read_backend(void* np, int b) {
+  if (b < RB_AUTO || b > RB_MAPPED) b = RB_AUTO;
+  ((Node*)np)->plane.backend.store(b);
+}
+
+// 1 when the library was built with io_uring support compiled in
+int srt_uring_compiled(void) {
+#ifdef SRT_HAVE_IOURING
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// the backend buffer-destination reads will actually use right now:
+// resolves `auto` and runs the availability probe (1 io_uring, 2
+// pread, 3 mapped-copy). The CI no-liburing matrix leg asserts this
+// reports the pread fallback.
+int srt_read_backend_effective(void* np) {
+  Node* n = (Node*)np;
+  int want = n->plane.backend.load();
+  if (want == RB_PREAD || want == RB_MAPPED) return want;
+  return plane_uring_probe(n->plane) ? RB_IOURING : RB_PREAD;
+}
+
+// test seam (read:enosys fault kind): make the availability probe
+// behave exactly like an ENOSYS kernel. Clearing it un-latches the
+// forced state so auto detection can run again.
+void srt_sq_force_probe_fail(void* np, int on) {
+  Node* n = (Node*)np;
+  n->plane.force_probe_fail.store(on ? 1 : 0);
+  if (!on) {
+    int st = -2;
+    n->plane.uring_state.compare_exchange_strong(st, 0);
+  }
+}
+
+// submission-queue accounting (transport.sq.* metric families)
+uint64_t srt_stat_sq_submits(void* np) {
+  return ((Node*)np)->plane.sq_submits.load();
+}
+uint64_t srt_stat_sq_batches(void* np) {
+  return ((Node*)np)->plane.sq_batches.load();
+}
+uint64_t srt_stat_sq_depth_hwm(void* np) {
+  return ((Node*)np)->plane.sq_depth_hwm.load();
+}
+uint64_t srt_stat_sq_completions(void* np) {
+  return ((Node*)np)->plane.sq_completions.load();
+}
+uint64_t srt_stat_sq_backend_fallbacks(void* np) {
+  return ((Node*)np)->plane.sq_backend_fallbacks.load();
 }
 
 uint64_t srt_region_count(void* np) {

@@ -25,11 +25,23 @@ _SRC = os.path.join(_HERE, "transport.cpp")
 # or dlopen dies allocating static TLS.
 _SANITIZE = os.environ.get("SPARKRDMA_NATIVE_SANITIZE", "").strip()
 
+# SPARKRDMA_NATIVE_NO_IOURING=1 compiles the io_uring read backend OUT
+# (-DSRT_NO_IOURING) into a separately cached .so — the CI matrix leg
+# proving the submission plane stays tier-1-green and reports the pread
+# fallback when the uapi header (or kernel) is absent.
+_NO_IOURING = os.environ.get(
+    "SPARKRDMA_NATIVE_NO_IOURING", ""
+).strip() not in ("", "0")
+
 
 def _so_path(base: str) -> str:
+    tags = []
     if _SANITIZE:
-        tag = _SANITIZE.replace(",", "-").replace("=", "_")
-        return os.path.join(_HERE, f"{base}.{tag}.so")
+        tags.append(_SANITIZE.replace(",", "-").replace("=", "_"))
+    if _NO_IOURING:
+        tags.append("nouring")
+    if tags:
+        return os.path.join(_HERE, f"{base}.{'.'.join(tags)}.so")
     return os.path.join(_HERE, f"{base}.so")
 
 
@@ -37,6 +49,8 @@ def _build_flags() -> list:
     flags = ["-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
     if _SANITIZE:
         flags += [f"-fsanitize={_SANITIZE}", "-fno-sanitize-recover=all", "-g"]
+    if _NO_IOURING:
+        flags.append("-DSRT_NO_IOURING")
     return flags
 
 
@@ -56,6 +70,10 @@ COMP_ACCEPT = 5
 ST_OK = 0
 ST_ERR = 1
 ST_REMOTE_ERR = 2
+
+# tpu.shuffle.native.readBackend values -> srt_set_read_backend codes
+# (RB_* enum in transport.cpp)
+READ_BACKENDS = {"auto": 0, "iouring": 1, "pread": 2, "mapped": 3}
 
 
 class SrtComp(ctypes.Structure):
@@ -119,6 +137,18 @@ def load() -> Optional[ctypes.CDLL]:
         lib.srt_stat_split_parts.argtypes = [ctypes.c_void_p]
         lib.srt_stat_block_stripes.restype = ctypes.c_uint64
         lib.srt_stat_block_stripes.argtypes = [ctypes.c_void_p]
+        # submission plane: backend knob, availability probe, SQ stats
+        lib.srt_set_read_backend.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.srt_uring_compiled.restype = ctypes.c_int
+        lib.srt_uring_compiled.argtypes = []
+        lib.srt_read_backend_effective.restype = ctypes.c_int
+        lib.srt_read_backend_effective.argtypes = [ctypes.c_void_p]
+        lib.srt_sq_force_probe_fail.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        for _stat in ("submits", "batches", "depth_hwm", "completions",
+                      "backend_fallbacks"):
+            fn = getattr(lib, f"srt_stat_sq_{_stat}")
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
         lib.srt_connect.restype = ctypes.c_uint64
         lib.srt_connect.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16,

@@ -180,6 +180,16 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "transport.reads_served": ("counter", _L({"purpose"})),
     "transport.read_bytes_served": ("counter", _L({"purpose"})),
     "transport.read_errors": ("counter", _L({"purpose"})),
+    # native read submission plane (native/transport.cpp SubmissionPlane,
+    # mirrored from the C++ atomics by transport/native_node.py);
+    # process-global: multiple in-process nodes sum into one family
+    "transport.sq.submits": ("counter", _L()),
+    "transport.sq.batches": ("counter", _L()),
+    "transport.sq.sqe_depth": ("gauge", _L()),
+    "transport.sq.completions": ("counter", _L()),
+    "transport.sq.backend_fallbacks": ("counter", _L()),
+    "transport.consume.workers": ("gauge", _L()),
+    "transport.consume.busy_ms": ("counter", _L()),
     # map/writer plane (shuffle/writer/)
     "writer.map_outputs": ("counter", _L({"method", "role"})),
     "writer.bytes_written": ("counter", _L({"role"})),

@@ -783,18 +783,14 @@ class TpuShuffleFetcherIterator:
 
         Mapped bytes never touch the mempool, so the tenant's quota
         ledger would be blind to them: the group's length is charged
-        against the ``pagecache`` broker for exactly the life of the
-        delivery (released once — on failure cleanup or when the last
-        stream closes)."""
+        against the ``pagecache`` broker through the submission plane's
+        single charge seam (``tenancy.quota.charge_pagecache``,
+        DESIGN.md §24) for exactly the life of the delivery (released
+        once — on failure cleanup or when the last stream closes)."""
         mid, group = fetch.manager_id, fetch.group
-        broker = _tquota.broker("pagecache")
-        if broker is not None:
-            broker.charge(self._tenant, group.total_length)
-        charge_once = threading.Lock()
-
-        def release_charge() -> None:
-            if broker is not None and charge_once.acquire(blocking=False):
-                broker.release(self._tenant, group.total_length)
+        release_charge = _tquota.charge_pagecache(
+            self._tenant, group.total_length
+        )
 
         fail = self._group_failure(fetch, cleanup=release_charge)
 

@@ -188,6 +188,34 @@ def broker(resource: str) -> Optional[QuotaBroker]:
     return _brokers.get(resource)
 
 
+def charge_pagecache(tenant: str, nbytes: int):
+    """THE page-cache charge seam for the read submission plane
+    (DESIGN.md §24): every mapped-delivery path — the fetcher's mapped
+    group READs and anything else that hands out page-cache windows
+    outside the mempool ledger — charges ``tenancy.pageCacheQuotaBytes``
+    through this one call site, so the backpressure semantics
+    (per-tenant blocking, ``block_max_ms`` overrun escape, isolation)
+    cannot drift between paths.
+
+    Charges ``nbytes`` now (blocking at the quota, exactly like
+    :meth:`QuotaBroker.charge`) and returns a release-once callable:
+    safe to invoke from both the failure-cleanup and the
+    last-stream-closed paths — only the first call releases. When no
+    ``pagecache`` broker is installed, returns a no-op without
+    touching any ledger."""
+    b = _brokers.get("pagecache")
+    if b is None:
+        return lambda: None
+    b.charge(tenant, nbytes)
+    once = threading.Lock()
+
+    def release() -> None:
+        if once.acquire(blocking=False):
+            b.release(tenant, nbytes)
+
+    return release
+
+
 def reset() -> None:
     """Drop installed brokers (tests only)."""
     with _table_lock:

@@ -27,7 +27,10 @@ Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
 deterministic byte of the delivered payload — the checksum layer's
 adversary), ``drop`` (connection drop for verbs; silent message loss
 for sends/rpc), ``kill``/``hang`` (exec seam only: process death /
-live-but-stuck).
+live-but-stuck), ``enosys`` (read seam only: force the native
+submission plane's io_uring probe to report unavailable — DESIGN.md
+§24 — then let the read proceed; the bytes must arrive identical via
+the pread fallback and ``transport.sq.backend_fallbacks`` must tick).
 
 Plans are spec strings — ``op:kind:count[:k=v[,k=v...]]`` joined with
 ``;`` — so they travel through conf keys (``tpu.shuffle.faultPlan`` +
@@ -57,7 +60,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 logger = logging.getLogger(__name__)
 
 OPS = ("read", "send", "rpc", "stage", "push", "exec")
-KINDS = ("fail", "delay", "corrupt", "drop", "kill", "hang")
+KINDS = ("fail", "delay", "corrupt", "drop", "kill", "hang", "enosys")
 
 
 class InjectedFault(IOError):
@@ -194,6 +197,17 @@ class FaultPlan:
             return listener, False
         rule, fire_index = hit
         logger.info("fault plan: %s read on %s", rule.kind, channel.peer_desc)
+        if rule.kind == "enosys":
+            # force the submission plane's io_uring probe to latch
+            # unavailable (as if io_uring_setup returned ENOSYS), then
+            # let the read proceed: the pread fallback must deliver
+            # byte-identical data. Pure-Python channels have no plane
+            # to degrade, so the rule is a counted no-op there.
+            node = getattr(channel, "_node", None)
+            force = getattr(node, "force_uring_probe_fail", None)
+            if force is not None:
+                force(True)
+            return listener, False
         if rule.kind == "fail":
             listener.on_failure(InjectedFault("injected read fault"))
             return listener, True

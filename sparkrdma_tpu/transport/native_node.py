@@ -20,6 +20,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import queue
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -399,6 +400,41 @@ class NativeTpuNode:
             lib.srt_set_file_workers(self._np, conf.file_workers)
         if conf.force_sendfile:
             lib.srt_set_force_sendfile(self._np, 1)
+        backend = conf.native_read_backend
+        if backend != "auto":
+            lib.srt_set_read_backend(self._np, tl.READ_BACKENDS[backend])
+
+        # consume lanes: READ_DONE checksum+decode sharded across
+        # threads, routed by channel so per-source completion order is
+        # preserved (the reduce pipeline's sequencer restores global
+        # order — delivery stays byte-identical). 1 lane degenerates to
+        # the old inline consume on the poll thread.
+        reg = get_registry()
+        self._consume_workers = conf.native_consume_workers
+        self._m_consume_busy = reg.counter("transport.consume.busy_ms")
+        self._consume_lanes: List["queue.SimpleQueue"] = []
+        self._consume_threads: List[threading.Thread] = []
+        if self._consume_workers > 1:
+            # gauge counts lanes actually running: inline consume
+            # (workers == 1) contributes nothing (OBSERVABILITY.md)
+            reg.gauge("transport.consume.workers").add(self._consume_workers)
+            for i in range(self._consume_workers):
+                lane: "queue.SimpleQueue" = queue.SimpleQueue()
+                t = threading.Thread(
+                    target=self._consume_loop, args=(lane,),
+                    name=f"srt-consume-{executor_id}-{i}", daemon=True,
+                )
+                self._consume_lanes.append(lane)
+                self._consume_threads.append(t)
+                t.start()
+
+        # submission-plane counter mirror: native atomics -> registry
+        # counters, synced as deltas from the poll thread (~1 Hz)
+        self._sq_synced = {
+            "submits": 0, "batches": 0, "completions": 0,
+            "backend_fallbacks": 0,
+        }
+        self._sq_next_sync = 0.0
 
         self._stopped = threading.Event()
         self._cq_thread = threading.Thread(
@@ -591,6 +627,55 @@ class NativeTpuNode:
             logger.exception("completion listener raised")
 
     # ------------------------------------------------------------------
+    # consume lanes (sharded READ_DONE checksum+decode)
+    # ------------------------------------------------------------------
+    def _consume(self, wr_id: int, payload, error: Optional[Exception]) -> None:
+        t0 = time.monotonic()
+        try:
+            self._complete_wr(wr_id, payload, error)
+        finally:
+            self._m_consume_busy.inc(int((time.monotonic() - t0) * 1000))
+
+    def _consume_loop(self, lane: "queue.SimpleQueue") -> None:
+        while True:
+            item = lane.get()
+            if item is None:
+                return
+            self._consume(*item)
+
+    def _sync_sq_metrics(self) -> None:
+        """Mirror the native SubmissionPlane atomics into the process
+        registry as deltas (multiple nodes sum into one family)."""
+        self._sq_next_sync = time.monotonic() + 1.0
+        np_handle = self._np
+        if not np_handle:
+            return
+        lib, reg = self._lib, get_registry()
+        cur = {
+            "submits": lib.srt_stat_sq_submits(np_handle),
+            "batches": lib.srt_stat_sq_batches(np_handle),
+            "completions": lib.srt_stat_sq_completions(np_handle),
+            "backend_fallbacks": lib.srt_stat_sq_backend_fallbacks(np_handle),
+        }
+        d = cur["submits"] - self._sq_synced["submits"]
+        if d > 0:
+            reg.counter("transport.sq.submits").inc(d)
+        d = cur["batches"] - self._sq_synced["batches"]
+        if d > 0:
+            reg.counter("transport.sq.batches").inc(d)
+        d = cur["completions"] - self._sq_synced["completions"]
+        if d > 0:
+            reg.counter("transport.sq.completions").inc(d)
+        d = cur["backend_fallbacks"] - self._sq_synced["backend_fallbacks"]
+        if d > 0:
+            reg.counter("transport.sq.backend_fallbacks").inc(d)
+        self._sq_synced = cur
+        depth = lib.srt_stat_sq_depth_hwm(np_handle)
+        gauge = reg.gauge("transport.sq.sqe_depth")
+        if depth > gauge.value:
+            gauge.set(depth)
+
+    # ------------------------------------------------------------------
     # CQ poll loop (RdmaThread analogue)
     # ------------------------------------------------------------------
     def _poll_loop(self) -> None:
@@ -611,6 +696,8 @@ class NativeTpuNode:
                 finally:
                     if c.payload:
                         self._lib.srt_free_payload(c.payload)
+            if time.monotonic() >= self._sq_next_sync:
+                self._sync_sq_metrics()
 
     def _dispatch(self, c: tl.SrtComp) -> None:
         if c.kind == tl.COMP_ACCEPT:
@@ -658,20 +745,33 @@ class NativeTpuNode:
         if c.kind == tl.COMP_READ_DONE:
             with self._lock:
                 lens = self._mapped_wrs.pop(c.wr_id, None)
+            # materialize the payload/error NOW, on the poll thread:
+            # the comps array is reused next batch and c.payload is
+            # freed in the poll loop's finally — nothing native may
+            # leak into a consume lane
+            error: Optional[Exception] = None
+            payload = None
             if c.status == tl.ST_OK:
                 payload = (
                     self._mapped_delivery(c, lens) if lens is not None else None
                 )
-                self._complete_wr(c.wr_id, payload, None)
             elif c.status == tl.ST_REMOTE_ERR:
                 msg = (
                     ctypes.string_at(c.payload, c.payload_len).decode("utf-8")
                     if c.payload
                     else "remote error"
                 )
-                self._complete_wr(c.wr_id, None, ChannelError(f"remote READ failed: {msg}"))
+                error = ChannelError(f"remote READ failed: {msg}")
             else:
-                self._complete_wr(c.wr_id, None, ChannelError("READ failed (channel down)"))
+                error = ChannelError("READ failed (channel down)")
+            if self._consume_lanes:
+                # shard checksum+decode across the lanes; channel-keyed
+                # routing keeps per-source FIFO order (error READ_DONEs
+                # posted by a dying channel stay ordered with its data)
+                lane = self._consume_lanes[c.channel % len(self._consume_lanes)]
+                lane.put((c.wr_id, payload, error))
+            else:
+                self._consume(c.wr_id, payload, error)
             return
         if c.kind == tl.COMP_CHANNEL_DOWN:
             lost_peer: Optional[str] = None
@@ -789,6 +889,46 @@ class NativeTpuNode:
             return 0
         return self._lib.srt_stat_block_stripes(np_handle)
 
+    def sq_stats(self) -> Dict[str, object]:
+        """Submission-plane accounting (transport.cpp SubmissionPlane):
+        SQ counters, the resolved read backend (`auto` probed), and
+        whether io_uring support was compiled in."""
+        np_handle = self._np
+        if not np_handle:
+            return {}
+        lib = self._lib
+        return {
+            "submits": lib.srt_stat_sq_submits(np_handle),
+            "batches": lib.srt_stat_sq_batches(np_handle),
+            "sqe_depth": lib.srt_stat_sq_depth_hwm(np_handle),
+            "completions": lib.srt_stat_sq_completions(np_handle),
+            "backend_fallbacks": lib.srt_stat_sq_backend_fallbacks(np_handle),
+            "backend": {1: "iouring", 2: "pread", 3: "mapped"}.get(
+                lib.srt_read_backend_effective(np_handle), "pread"
+            ),
+            "uring_compiled": bool(lib.srt_uring_compiled()),
+            "consume_workers": self._consume_workers,
+        }
+
+    def force_uring_probe_fail(self, on: bool) -> None:
+        """Test seam (and the ``read:enosys`` fault kind): make the
+        io_uring availability probe behave like an ENOSYS kernel, so
+        degradation to pread is exercised deterministically."""
+        np_handle = self._np
+        if np_handle:
+            self._lib.srt_sq_force_probe_fail(np_handle, 1 if on else 0)
+
+    def set_read_backend(self, backend: str) -> None:
+        """Switch the submission-plane backend at runtime (normally
+        fixed by ``tpu.shuffle.native.readBackend`` at init) — the
+        per-backend A/Bs and byte-identity tests flip it between sides
+        on one node."""
+        np_handle = self._np
+        if np_handle:
+            self._lib.srt_set_read_backend(
+                np_handle, tl.READ_BACKENDS[backend]
+            )
+
     def _close_channel(self, ch: NativeTpuChannel) -> None:
         ch._dead.set()
         if not self._stopped.is_set():
@@ -809,6 +949,18 @@ class NativeTpuNode:
             # it under the still-running poller (use-after-free)
             logger.error("cq poll thread failed to stop; leaking native node")
             self._np = None
+        # drain the consume lanes: the poll thread is out, so every
+        # READ_DONE it routed is already queued; sentinels let each lane
+        # finish its FIFO before the node tears down underneath it
+        for lane in self._consume_lanes:
+            lane.put(None)
+        for t in self._consume_threads:
+            t.join(timeout=10.0)
+        if self._consume_threads:
+            get_registry().gauge("transport.consume.workers").add(
+                -self._consume_workers
+            )
+        self._sync_sq_metrics()
         with self._lock:
             channels = list(self._channels.values())
             self._channels.clear()

@@ -15,6 +15,7 @@ device exchange plane (bucket sizes, mesh axes).
 from __future__ import annotations
 
 import enum
+import os
 from typing import Dict, Optional
 
 from sparkrdma_tpu.utils.units import parse_bytes
@@ -110,6 +111,8 @@ DECLARED_KNOBS: Dict[str, str] = {
     "forceSendfile": "serve file regions via sendfile to loopback",
     "fileWorkers": "native same-host file-task workers",
     "mappedFetch": "zero-copy mmap delivery on native transport",
+    "native.readBackend": "submission-plane backend: auto|iouring|pread|mapped",
+    "native.consumeWorkers": "completion-consume lanes on the native CQ",
     "exchange.bucketMin": "smallest padded exchange bucket",
     "exchange.bucketMax": "largest padded exchange bucket",
     "hbm.slabBytes": "HBM staging slab size",
@@ -621,6 +624,35 @@ class TpuShuffleConf:
         never slower than the buffer path; off restores pooled
         registered destination buffers."""
         return self._bool("mappedFetch", True)
+
+    @property
+    def native_read_backend(self) -> str:
+        """Submission-plane backend for same-host file reads in the
+        native transport (DESIGN.md §24). ``auto`` probes io_uring at
+        runtime and falls back to pread; ``iouring`` requests it
+        explicitly (still degrades cleanly on ENOSYS/old kernels);
+        ``pread`` is the preadv2-scatter path; ``mapped`` copies
+        through mmap+MAP_POPULATE windows. Every backend produces
+        byte-identical results."""
+        raw = (
+            self._conf.get(PREFIX + "native.readBackend", "auto") or "auto"
+        ).lower()
+        if raw not in ("auto", "iouring", "pread", "mapped"):
+            raw = "auto"
+        return raw
+
+    @property
+    def native_consume_workers(self) -> int:
+        """Consume lanes draining the native completion queue: checksum
+        verify + decode run in parallel per source-ordered lane
+        (completions are routed by channel, so per-source order is
+        preserved and the reduce pipeline's sequencer keeps delivery
+        byte-identical). Default min(cores-1, 4), floor 1 — a 1-core
+        rig degenerates to the old inline consume."""
+        cores = os.cpu_count() or 1
+        return self._int(
+            "native.consumeWorkers", min(max(cores - 1, 1), 4), 1, 16
+        )
 
     # -- TPU device exchange plane (new; no reference analogue) -----------
     @property

@@ -439,3 +439,60 @@ def test_two_tenant_concurrent_jobs_correct_and_labeled():
     assert any("tenant=bob" in k for k in task_keys)
     engine_keys = [k for k in snap["histograms"] if k.startswith("engine.task_ms")]
     assert any("tenant=alice" in k for k in engine_keys)
+
+def test_charge_pagecache_stalls_offender_bounded_not_neighbors():
+    """The submission plane's mapped-read charge seam (DESIGN.md §24,
+    ``quota.charge_pagecache``): an over-quota tenant's next mapped
+    fetch stalls — bounded by ``quotaBlockMaxMs`` — while ANOTHER
+    tenant's mapped fetch flows untouched, and the returned release
+    callable is once-only no matter how many completion paths call it."""
+    conf = TpuShuffleConf({
+        "tpu.shuffle.tenancy.pageCacheQuotaBytes": "100",
+        "tpu.shuffle.tenancy.quotaBlockMaxMs": "300",
+    })
+    _quota.install(conf)
+    rel_a1 = _quota.charge_pagecache("a", 80)
+    blocked = threading.Event()
+    passed = threading.Event()
+    releases = []
+
+    def offender():
+        blocked.set()
+        releases.append(_quota.charge_pagecache("a", 80))  # over quota
+        passed.set()
+
+    t = threading.Thread(target=offender, daemon=True)
+    t.start()
+    blocked.wait(5)
+    time.sleep(0.05)
+    assert not passed.is_set(), "over-quota mapped charge should stall"
+    # isolation: tenant b's mapped fetch flows while a is stalled
+    t0 = time.perf_counter()
+    rel_b = _quota.charge_pagecache("b", 80)
+    assert time.perf_counter() - t0 < 0.5
+    rel_b()
+    # releasing a's held delivery unblocks the stalled fetch
+    rel_a1()
+    assert passed.wait(5), "release did not unblock the stalled fetch"
+    t.join(timeout=5)
+    broker = _quota.broker("pagecache")
+    assert broker.usage("a") == 80
+    # release-once: failure cleanup AND last-stream-close may both call
+    releases[0]()
+    releases[0]()
+    assert broker.usage("a") == 0
+    # the stall is BOUNDED even with no release at all
+    rel_c = _quota.charge_pagecache("c", 80)
+    t0 = time.perf_counter()
+    rel_c2 = _quota.charge_pagecache("c", 80)
+    dt = time.perf_counter() - t0
+    assert 0.1 <= dt < 2.0, f"expected ~300ms bounded stall, got {dt:.3f}s"
+    rel_c()
+    rel_c2()
+
+
+def test_charge_pagecache_noop_without_broker():
+    assert _quota.broker("pagecache") is None
+    rel = _quota.charge_pagecache("t", 1 << 20)  # must not charge or raise
+    rel()
+    rel()
