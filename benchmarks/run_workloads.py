@@ -60,6 +60,7 @@ def bench_engine_terasort(scale: float, transport: str):
         rdd = ctx.parallelize(data, 8).sort_by_key(num_partitions=8)
         out = ctx.run_job(rdd)
         dt = time.perf_counter() - t0
+        bd = ctx.last_breakdown  # critical-path verdict (obs/critpath.py)
     assert len(out) == n
     assert all(out[i][0] <= out[i + 1][0] for i in range(min(1000, n - 1)))
     report(
@@ -67,6 +68,7 @@ def bench_engine_terasort(scale: float, transport: str):
         records=n, transport=transport,
         mb=round(n * 100 / 1e6, 1),
         records_per_s=int(n / dt),
+        breakdown=bd.to_dict() if bd is not None else None,
     )
 
 
@@ -908,6 +910,13 @@ if __name__ == "__main__":
                     "e2e_gb": args.e2e_gb,
                     "workloads": RECORDS,
                     "obs_registry": get_registry().snapshot(),
+                    # last per-job critical-path verdict, if a workload
+                    # produced one (obs --critical-path reads this)
+                    "breakdown": next(
+                        (r.get("breakdown") for r in reversed(RECORDS)
+                         if r.get("breakdown")),
+                        None,
+                    ),
                     "trace_file": trace_out,
                     "telemetry_timeline": hub.timeline(),
                     "stragglers": hub.straggler_report(),

@@ -77,6 +77,8 @@ class ClusterContext:
         self._shuffle_counter = 0
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=max(4, num_executors * 2))
+        # last finished job's critical-path verdict (obs/attr.py)
+        self.last_breakdown = None
 
         conf_json = json.dumps(self.conf.to_dict())  # includes driverPort
         for i in range(num_executors):
@@ -167,20 +169,47 @@ class ClusterContext:
         )
         self.driver.register_shuffle(handle)
         admission = self.driver.admission
+        jsp = None
         try:
             with tenancy.tenant_scope(t):
-                if admission is None:
-                    return self._run_map_reduce(
-                        handle, map_fns, num_partitions, reduce_fn, t
-                    )
-                with admission.admit(t):
-                    return self._run_map_reduce(
-                        handle, map_fns, num_partitions, reduce_fn, t
-                    )
+                # the job span bounds the critical-path window
+                # (obs/critpath.py) for the driver-visible spans
+                with self.driver.tracer.span(
+                    "job.run", shuffle_id=handle.shuffle_id, tenant=t
+                ) as jsp:
+                    if admission is None:
+                        out = self._run_map_reduce(
+                            handle, map_fns, num_partitions, reduce_fn, t
+                        )
+                    else:
+                        with admission.admit(t):
+                            out = self._run_map_reduce(
+                                handle, map_fns, num_partitions, reduce_fn, t
+                            )
+            self._attribute_job(jsp)
+            return out
         except Exception as e:
             if self.driver.telemetry is not None:
-                self.driver.telemetry.flight_record("job_failed", error=e)
+                bd = self._attribute_job(jsp)
+                self.driver.telemetry.flight_record(
+                    "job_failed", error=e,
+                    breakdown=bd.to_dict() if bd is not None else None,
+                )
             raise
+
+    def _attribute_job(self, job_span):
+        """Best-effort per-job TimeBreakdown (obs/critpath.py) over the
+        driver-process spans; kept as ``self.last_breakdown``."""
+        if job_span is None or not self.conf.critpath_enabled:
+            return None
+        try:
+            from sparkrdma_tpu.obs.critpath import job_breakdown
+
+            self.last_breakdown = job_breakdown(job_span, role="driver")
+            return self.last_breakdown
+        except Exception:
+            logger.exception("critical-path attribution failed")
+            return None
 
     def _run_map_reduce(self, handle, map_fns, num_partitions, reduce_fn, tenant):
         items = list(enumerate(map_fns))

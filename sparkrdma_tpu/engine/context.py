@@ -67,6 +67,9 @@ class TpuContext:
         self._rdd_counter = 0
         self._shuffle_counter = 0
         self._stopped = False
+        # last finished job's critical-path attribution verdict
+        # (obs/attr.py TimeBreakdown), surfaced via metrics_snapshot()
+        self.last_breakdown = None
         # in-process topology: heartbeats push straight into the driver
         # hub (no control-plane hop); each executor samples its own
         # role-filtered view of the shared process registry
@@ -188,13 +191,16 @@ class TpuContext:
                 if plan is not None:
                     plan.on_stage("map_task", [], peer=executor.executor_id)
                 try:
-                    writer = executor.get_writer(handle, map_id)
-                    try:
-                        writer.write(parent.compute(map_id))
-                        writer.stop(True)
-                    except Exception:
-                        writer.stop(False)
-                        raise
+                    with executor.tracer.span(
+                        "engine.task", kind="map", partition=map_id
+                    ):
+                        writer = executor.get_writer(handle, map_id)
+                        try:
+                            writer.write(parent.compute(map_id))
+                            writer.stop(True)
+                        except Exception:
+                            writer.stop(False)
+                            raise
                 finally:
                     get_registry().histogram(
                         "engine.task_ms", role=executor.executor_id,
@@ -265,44 +271,59 @@ class TpuContext:
 
     def _run_job_admitted(self, rdd: RDD, tenant: str) -> List:
         for attempt in range(2):
+            jsp = None
             try:
-                self.ensure_parents(rdd)
-                order = list(range(rdd.num_partitions))
-                weights = self._partition_weights(rdd)
-                if weights:
-                    order.sort(key=lambda p: -weights.get(p, 0))
+                # the job span bounds the critical-path window
+                # (obs/critpath.py); every map/reduce span of this
+                # attempt lands inside it on the shared timeline
+                with self.driver.tracer.span(
+                    "job.run", tenant=tenant, attempt=attempt
+                ) as jsp:
+                    self.ensure_parents(rdd)
+                    order = list(range(rdd.num_partitions))
+                    weights = self._partition_weights(rdd)
+                    if weights:
+                        order.sort(key=lambda p: -weights.get(p, 0))
 
-                def run_reduce(p: int) -> List:
-                    t0 = time.perf_counter()
-                    try:
-                        return list(rdd.compute(p))
-                    finally:
-                        get_registry().histogram(
-                            "engine.task_ms", role="driver", kind="reduce",
-                            tenant=tenancy.current_tenant(),
-                        ).observe((time.perf_counter() - t0) * 1000.0)
+                    def run_reduce(p: int) -> List:
+                        t0 = time.perf_counter()
+                        try:
+                            # task span: keeps the critical path lit
+                            # across user compute (obs/attr.py)
+                            with self.driver.tracer.span(
+                                "engine.task", kind="reduce", partition=p
+                            ):
+                                return list(rdd.compute(p))
+                        finally:
+                            get_registry().histogram(
+                                "engine.task_ms", role="driver", kind="reduce",
+                                tenant=tenancy.current_tenant(),
+                            ).observe((time.perf_counter() - t0) * 1000.0)
 
-                futures = {
-                    p: self._pool.submit(run_reduce, p)
-                    for p in order
-                }
-                out: List = []
-                errors = []
-                for p in range(rdd.num_partitions):
-                    f = futures[p]
-                    e = f.exception()
-                    if e is not None:
-                        errors.append(e)
-                    else:
-                        out.extend(f.result())
-                if not errors:
-                    return out
-                raise errors[0]
+                    futures = {
+                        p: self._pool.submit(run_reduce, p)
+                        for p in order
+                    }
+                    out: List = []
+                    errors = []
+                    for p in range(rdd.num_partitions):
+                        f = futures[p]
+                        e = f.exception()
+                        if e is not None:
+                            errors.append(e)
+                        else:
+                            out.extend(f.result())
+                    if errors:
+                        raise errors[0]
+                self._attribute_job(jsp)
+                return out
             except ShuffleError as e:
                 if self.driver.telemetry is not None:
                     # post-mortem artifact BEFORE recompute mutates state
+                    bd = self._attribute_job(jsp)
                     self.driver.telemetry.flight_record(
-                        "fetch_failed", error=e
+                        "fetch_failed", error=e,
+                        breakdown=bd.to_dict() if bd is not None else None,
                     )
                 if attempt == 1:
                     raise
@@ -312,6 +333,22 @@ class TpuContext:
                 for dep in self._shuffle_deps(rdd):
                     dep.handle = None
         raise RuntimeError("unreachable")
+
+    def _attribute_job(self, job_span):
+        """Fold the finished (or failed) job span's window into a
+        TimeBreakdown (obs/critpath.py). Best-effort: attribution can
+        never fail a job. Returns the verdict (also kept as
+        ``self.last_breakdown``) or None when gated off."""
+        if job_span is None or not self.conf.critpath_enabled:
+            return None
+        try:
+            from sparkrdma_tpu.obs.critpath import job_breakdown
+
+            self.last_breakdown = job_breakdown(job_span, role="driver")
+            return self.last_breakdown
+        except Exception:
+            logger.exception("critical-path attribution failed")
+            return None
 
     # ------------------------------------------------------------------
     def metrics_snapshot(self) -> Dict[str, dict]:
@@ -327,6 +364,8 @@ class TpuContext:
         for executor in self.executors:
             snap[executor.executor_id] = executor.metrics_snapshot()
         snap["registry"] = get_registry().snapshot()
+        if self.last_breakdown is not None:
+            snap["breakdown"] = self.last_breakdown.to_dict()
         return snap
 
     def telemetry_flush(self) -> None:

@@ -95,13 +95,16 @@ class Worker:
             plan.on_exec(self.manager.executor_id, stage="map_task")
             plan.on_stage("map_task", [], peer=self.manager.executor_id)
         try:
-            writer = self.manager.get_writer(handle, map_id)
-            try:
-                writer.write(records_fn())
-                writer.stop(True)
-            except Exception:
-                writer.stop(False)
-                raise
+            with self.manager.tracer.span(
+                "engine.task", kind="map", partition=map_id
+            ):
+                writer = self.manager.get_writer(handle, map_id)
+                try:
+                    writer.write(records_fn())
+                    writer.stop(True)
+                except Exception:
+                    writer.stop(False)
+                    raise
         finally:
             get_registry().histogram(
                 "engine.task_ms", role=self.manager.executor_id, kind="map",
@@ -162,6 +165,8 @@ class Worker:
                     req["source"],
                     req.get("blocks") or [],
                     req.get("final"),
+                    req.get("origin_span", 0),
+                    req.get("origin_trace", 0),
                 )
             return {"ok": True, "result": accepted}
         if kind == "reduce":
@@ -177,9 +182,13 @@ class Worker:
                 with self._reduce_lock:
                     self._reduces[rkey] = reader
                 try:
-                    it = reader.read()
-                    fn = req.get("reduce_fn")
-                    result = fn(it) if fn is not None else list(it)
+                    with self.manager.tracer.span(
+                        "engine.task", kind="reduce", start=req["start"],
+                        end=req["end"],
+                    ):
+                        it = reader.read()
+                        fn = req.get("reduce_fn")
+                        result = fn(it) if fn is not None else list(it)
                 finally:
                     with self._reduce_lock:
                         if self._reduces.get(rkey) is reader:

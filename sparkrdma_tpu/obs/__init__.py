@@ -28,9 +28,11 @@ from sparkrdma_tpu.obs.telemetry import Heartbeater, TelemetryHub
 from sparkrdma_tpu.obs.timeseries import TimeSeriesRing, Window
 from sparkrdma_tpu.obs.trace import (
     Span,
+    SpanHandle,
     Tracer,
     all_tracers,
     collect_spans,
+    collect_spans_with_epochs,
     export_chrome_trace,
     get_tracer,
     mint_trace_id,
@@ -46,12 +48,14 @@ __all__ = [
     "MetricsRegistry",
     "OpenMetricsServer",
     "Span",
+    "SpanHandle",
     "TelemetryHub",
     "TimeSeriesRing",
     "Tracer",
     "Window",
     "all_tracers",
     "collect_spans",
+    "collect_spans_with_epochs",
     "export_chrome_trace",
     "extract_snapshot",
     "get_registry",

@@ -13,7 +13,9 @@ OpenMetrics text exposition instead of the JSON dump ('-' or no value
 = stdout), from the live registry or — with ``--from-snapshot FILE`` —
 from a registry snapshot saved inside a bench/workload artifact JSON.
 ``--flight-recorder FILE`` pretty-prints a flight-record artifact
-(obs/telemetry.py) and exits.
+(obs/telemetry.py) and exits. ``--critical-path FILE`` replays the
+critical-path attribution (obs/critpath.py) over a saved Chrome trace,
+or prints the ``breakdown`` stored in a bench/flight artifact.
 
 The demo is jax-free: it exercises the host shuffle planes (transport,
 rpc, writer, mempool, reader) only.
@@ -101,6 +103,55 @@ def _print_flight(path: str) -> int:
     return 0
 
 
+def _print_critical_path(path: str, top: int = 12) -> int:
+    from sparkrdma_tpu.obs.attr import attribute
+    from sparkrdma_tpu.obs.critpath import extract, spans_from_chrome
+
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        spans = spans_from_chrome(doc)
+        if not spans:
+            print(f"{path}: no spans carry args.span_id — exported by an "
+                  "older to_chrome_trace?", file=sys.stderr)
+            return 2
+        jobs = [p for p in spans if p.name == "job.run"]
+        if jobs:
+            job = max(jobs, key=lambda p: p.t1)
+            t0, t1, exclude = job.t0, job.t1, {job.span_id}
+            print(f"window: job.run span {job.span_id}")
+        else:
+            t0 = min(p.t0 for p in spans)
+            t1 = max(p.t1 for p in spans)
+            exclude = set()
+            print("window: full trace extent (no job.run span found)")
+        cp = extract(spans, t0, t1, exclude=exclude)
+        print(attribute(cp, top_segments=top).render())
+        print("top segments:")
+        for seg in cp.top_segments(top):
+            label = seg.name if seg.kind == "span" else "(idle/untraced)"
+            role = f" [{seg.role}]" if seg.role else ""
+            print(f"  {seg.dur_s * 1e3:10.3f} ms  {label}{role}")
+        return 0
+    bd = doc.get("breakdown") if isinstance(doc, dict) else None
+    if bd:
+        print(f"stored breakdown: wall {bd.get('wall_ms')} ms, "
+              f"coverage {float(bd.get('coverage', 0.0)) * 100:.1f}%")
+        cats = bd.get("categories_ms") or {}
+        for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1]):
+            print(f"  {cat:<16} {ms:10.3f} ms")
+        segs = bd.get("critical_path") or []
+        if segs:
+            print("top segments:")
+            for seg in segs[:top]:
+                label = seg.get("name") or "(idle/untraced)"
+                print(f"  {seg.get('ms', 0.0):10.3f} ms  {label}")
+        return 0
+    print(f"{path}: neither a Chrome trace (traceEvents) nor an artifact "
+          "with a stored 'breakdown'", file=sys.stderr)
+    return 2
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sparkrdma_tpu.obs",
@@ -136,10 +187,18 @@ def main(argv=None) -> int:
         "--flight-recorder", default=None, metavar="FILE",
         help="pretty-print a flight-record JSON artifact and exit",
     )
+    ap.add_argument(
+        "--critical-path", default=None, metavar="FILE",
+        help="print the critical-path TimeBreakdown and top segments from "
+        "a saved Chrome trace (traceEvents) or from the 'breakdown' stored "
+        "in a bench/flight artifact, then exit",
+    )
     args = ap.parse_args(argv)
 
     if args.flight_recorder:
         return _print_flight(args.flight_recorder)
+    if args.critical_path:
+        return _print_critical_path(args.critical_path)
     if args.demo:
         _run_demo()
     if args.trace_out:

@@ -1,0 +1,132 @@
+"""Per-job time attribution: critical-path segments -> category verdict.
+
+Folds a :class:`~sparkrdma_tpu.obs.critpath.CriticalPath` into a
+:class:`TimeBreakdown` — the "where did this job's wall time actually
+go" answer, in a fixed category vocabulary (docs/OBSERVABILITY.md
+"Critical path & attribution"):
+
+- ``device-compute`` — device sort / merge / exchange kernels,
+- ``dma-wave``       — collective DMA waves and the device fetch plane,
+- ``host-read``      — one-sided READ service, fetch groups, native
+                       submit→complete intervals,
+- ``decode``         — frame parse / checksum / deserialize,
+- ``rpc``            — control-plane publish/resolve/fetch-request and
+                       push/seal messaging,
+- ``queue-wait``     — fair-share DRR submit→dispatch parking,
+- ``other``          — traced spans outside the vocabulary,
+- ``idle-untraced``  — critical-path gaps (nothing traced was running).
+
+Categories are assigned by longest-matching span-name prefix, so new
+span families degrade to ``other`` rather than silently vanishing.
+
+Stdlib-only and jax-free, like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from sparkrdma_tpu.obs.critpath import CriticalPath
+
+DEVICE_COMPUTE = "device-compute"
+DMA_WAVE = "dma-wave"
+HOST_READ = "host-read"
+DECODE = "decode"
+RPC = "rpc"
+QUEUE_WAIT = "queue-wait"
+OTHER = "other"
+IDLE = "idle-untraced"
+
+CATEGORIES: Tuple[str, ...] = (
+    DEVICE_COMPUTE, DMA_WAVE, HOST_READ, DECODE, RPC, QUEUE_WAIT, OTHER, IDLE,
+)
+
+# span-name prefix -> category; longest prefix wins (so
+# ``shuffle.collective.wave`` beats ``shuffle.collective``).
+PREFIX_CATEGORIES: Dict[str, str] = {
+    "engine.task": DEVICE_COMPUTE,  # task compute (sort/combine/user fns)
+    "writer.pipeline.sort": DEVICE_COMPUTE,
+    "reader.pipeline.merge": DEVICE_COMPUTE,
+    "reader.pipeline.stage": DEVICE_COMPUTE,
+    "writer.pipeline.stage": DEVICE_COMPUTE,
+    "exchange.": DEVICE_COMPUTE,
+    "shuffle.collective.wave": DMA_WAVE,
+    "shuffle.collective": DMA_WAVE,
+    "device_fetch.": DMA_WAVE,
+    "shuffle.fetch": HOST_READ,  # fetch group (NOT fetch_request: see RPC)
+    "transport.native_read": HOST_READ,
+    "reader.pipeline.fetch": HOST_READ,
+    "shuffle.read": HOST_READ,
+    "reader.pipeline.decode": DECODE,
+    "shuffle.fetch_request": RPC,
+    "shuffle.publish": RPC,
+    "shuffle.resolve": RPC,
+    "shuffle.register": RPC,
+    "writer.pipeline.publish": RPC,
+    "shuffle.push": RPC,
+    "shuffle.merge_seal": RPC,
+    "tenant.queue_wait": QUEUE_WAIT,
+}
+_PREFIXES_BY_LEN = sorted(PREFIX_CATEGORIES, key=len, reverse=True)
+
+
+def classify(name: str) -> str:
+    """Category for one span name (longest matching prefix, else other)."""
+    for prefix in _PREFIXES_BY_LEN:
+        if name.startswith(prefix):
+            return PREFIX_CATEGORIES[prefix]
+    return OTHER
+
+
+class TimeBreakdown:
+    """One job's attribution verdict: wall, per-category ms, coverage."""
+
+    __slots__ = ("wall_ms", "categories", "coverage", "critical_path")
+
+    def __init__(self, wall_ms: float, categories: Dict[str, float],
+                 coverage: float, critical_path: List[dict]):
+        self.wall_ms = wall_ms
+        self.categories = categories
+        self.coverage = coverage
+        self.critical_path = critical_path
+
+    def to_dict(self) -> dict:
+        return {
+            "wall_ms": round(self.wall_ms, 3),
+            "coverage": round(self.coverage, 4),
+            "categories_ms": {
+                k: round(v, 3) for k, v in self.categories.items()
+            },
+            "critical_path": self.critical_path,
+        }
+
+    def render(self) -> str:
+        """Fixed-width table for CLIs and logs."""
+        lines = [f"wall {self.wall_ms:10.3f} ms   "
+                 f"coverage {self.coverage * 100:5.1f}%"]
+        wall = self.wall_ms or 1.0
+        for cat in CATEGORIES:
+            ms = self.categories.get(cat, 0.0)
+            if ms <= 0.0:
+                continue
+            lines.append(f"  {cat:<16} {ms:10.3f} ms  {ms / wall * 100:5.1f}%")
+        return "\n".join(lines)
+
+
+def attribute(path: CriticalPath, top_segments: int = 12) -> TimeBreakdown:
+    """Fold a critical path into the category verdict."""
+    cats: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+    for seg in path.segments:
+        cat = IDLE if seg.kind == "gap" else classify(seg.name)
+        cats[cat] += seg.dur_s * 1e3
+    # traced-category coverage: everything except the idle bucket,
+    # normalized to wall — the ≥90% acceptance gate reads this
+    wall_ms = path.wall_s * 1e3
+    traced_ms = sum(v for k, v in cats.items() if k != IDLE)
+    coverage = (traced_ms / wall_ms) if wall_ms > 1e-3 else 1.0
+    return TimeBreakdown(
+        wall_ms,
+        {k: v for k, v in cats.items() if v > 0.0},
+        min(1.0, coverage),
+        [s.to_dict() for s in path.top_segments(top_segments)],
+    )

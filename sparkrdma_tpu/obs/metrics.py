@@ -61,6 +61,10 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "collective.lane_plans": ("counter", _L({"role"})),
     "collective.plan_ms": ("histogram", _L({"role"})),
     "collective.wave_ms": ("histogram", _L({"role", "schedule"})),
+    # critical-path attribution (obs/critpath.py)
+    "critpath.builds": ("counter", _L({"role"})),
+    "critpath.build_ms": ("histogram", _L({"role"})),
+    "critpath.coverage_pct": ("gauge", _L()),
     # device fetch plane (shuffle/device_fetch.py, device_io.py)
     "device_fetch.bytes": ("counter", _L()),
     "device_fetch.stage_ms": ("histogram", _L()),
@@ -164,6 +168,11 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "tenant.quota_overruns": ("counter", _L({"resource", "tenant"})),
     "tenant.quota_wait_ms": ("histogram", _L({"resource", "tenant"})),
     "tenant.bytes": ("gauge", _L({"resource", "tenant"})),
+    # perf-trend engine over bench ledgers (obs/trend.py)
+    "trend.rounds": ("gauge", _L({"family"})),
+    "trend.series": ("gauge", _L()),
+    "trend.regressions": ("counter", _L()),
+    "trend.skipped_rows": ("counter", _L()),
     # host transport (transport/)
     "transport.connects": ("counter", _L({"purpose"})),
     "transport.connect_retries": ("counter", _L({"purpose"})),

@@ -142,12 +142,18 @@ class Heartbeater:
             self._prev = cur
             self._seq += 1
             seq = self._seq
+        from sparkrdma_tpu.obs.trace import epoch_anchor
+
         payload = {
             "v": 1,
             "executor_id": self.executor_id,
             "seq": seq,
             "wall_ms": int(self._clock() * 1000),
             "interval_ms": self.interval_ms,
+            # wall-clock anchor of this process's span timeline: the
+            # hub hands these to the trace exporter so cross-process
+            # merges don't skew by per-process module-load epochs
+            "epoch_ms": int(epoch_anchor() * 1000),
             "counters": {k: v for k, v in delta["counters"].items() if v},
             "gauges": {
                 k: g for k, g in delta["gauges"].items()
@@ -280,6 +286,9 @@ class TelemetryHub:
 
         self._lock = threading.Lock()
         self._series: Dict[str, TimeSeriesRing] = {}
+        # executor -> wall-clock span-timeline anchor (seconds), from
+        # the heartbeat's epoch_ms; consumed by trace-merge exports
+        self._epoch_anchors: Dict[str, float] = {}
         # per-executor missed-heartbeat accounting: True once the gap
         # was counted; cleared (and surfaced as a ring gap marker) when
         # the executor resumes
@@ -374,6 +383,12 @@ class TelemetryHub:
                 self._g_missed.add(seq - ring.last_seq - 1)
             if self._missed_counted.pop(exec_id, False):
                 gap = True  # resumed after a wall-clock gap
+            anchor = payload.get("epoch_ms")
+            if anchor:
+                try:
+                    self._epoch_anchors[exec_id] = float(anchor) / 1000.0
+                except (TypeError, ValueError):
+                    pass
             self._g_executors.set(len(self._series))
         ring.append(
             wall_ms,
@@ -418,6 +433,15 @@ class TelemetryHub:
     def executors(self) -> List[str]:
         with self._lock:
             return sorted(self._series)
+
+    def epoch_anchors(self) -> Dict[str, float]:
+        """Role → wall-clock span-timeline anchor (seconds), learned
+        from heartbeats. Hand to ``to_chrome_trace(epochs=...)`` /
+        ``collect_spans_with_epochs`` when merging spans shipped from
+        other processes, so per-process module-load epochs don't skew
+        the merged timeline."""
+        with self._lock:
+            return dict(self._epoch_anchors)
 
     def series(self, executor_id: str) -> Optional[TimeSeriesRing]:
         with self._lock:
@@ -603,12 +627,15 @@ class TelemetryHub:
 
     # -- egress: flight recorder ---------------------------------------
     def flight_record(self, reason: str, error: Optional[BaseException] = None,
-                      path: Optional[str] = None) -> Optional[str]:
+                      path: Optional[str] = None,
+                      breakdown: Optional[dict] = None) -> Optional[str]:
         """Dump the post-mortem artifact: last N ring windows per
         executor + recent spans + circuit-breaker states + the failed
         group (from the error's ``shuffle_id``/``partition_id``/
-        ``manager_id`` attributes when present). Best-effort: returns
-        the written path, or None — never a new failure mode."""
+        ``manager_id`` attributes when present). ``breakdown`` attaches
+        the failed window's critical-path TimeBreakdown dict
+        (obs/attr.py) when the caller computed one. Best-effort:
+        returns the written path, or None — never a new failure mode."""
         doc: dict = {
             "kind": "sparkrdma_flight_record",
             "version": 1,
@@ -622,6 +649,8 @@ class TelemetryHub:
                 self._health.states() if self._health is not None else {}
             ),
         }
+        if breakdown is not None:
+            doc["breakdown"] = breakdown
         if error is not None:
             doc["error"] = {
                 "type": type(error).__name__,

@@ -108,6 +108,12 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # it: a PartitionLocation is >= 28 bytes, so an 8-byte residue is
     # unambiguously the extension, never a truncated location.
     trace_id: int = 0
+    # observability: span id of the sender-side span this message hands
+    # off from (obs/trace.py SpanHandle; 0 = none). Carried in the
+    # 0xFFFB follows extension so the receiver can add a causal
+    # ``follows`` edge — the publish→record and resolve→fetch legs of
+    # the cross-role critical path (docs/OBSERVABILITY.md).
+    origin_span: int = 0
 
     # is_last(1) shuffle_id(4) partition_id(4) num_map_outputs(4)
     _HDR = struct.Struct(">Biii")
@@ -156,6 +162,14 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # zero extension bytes — legacy frames stay byte-identical.
     _ELA_MARKER = 0xFFFC
     _ELA_ITEM = struct.Struct(">iH")
+    # message-level follows extension (critical-path attribution):
+    # written AFTER the elastic extension, BEFORE the trace extension.
+    # Same impossible-host-length marker trick with 0xFFFB. Layout:
+    # _EXT_HDR with count 1, then one origin_span(u8) — the sender-side
+    # span id this message causally follows. Messages with no origin
+    # span emit zero extension bytes — legacy frames stay byte-identical.
+    _FLW_MARKER = 0xFFFB
+    _FLW_ITEM = struct.Struct(">Q")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
@@ -172,6 +186,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
             for loc in self.locations
         )
         ela_fixed = self._EXT_HDR.size if has_ela else 0
+        flw_fixed = (
+            self._EXT_HDR.size + self._FLW_ITEM.size if self.origin_span else 0
+        )
         budget = (
             seg_size
             - SEG_HEADER.size
@@ -181,6 +198,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             - dev_fixed
             - mrg_fixed
             - ela_fixed
+            - flw_fixed
         )
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
@@ -245,6 +263,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
                     rep = loc.block.replica_of.encode("utf-8")
                     buf.write(self._ELA_ITEM.pack(loc.block.source_map, len(rep)))
                     buf.write(rep)
+            if self.origin_span:
+                buf.write(self._EXT_HDR.pack(self._FLW_MARKER, 1))
+                buf.write(self._FLW_ITEM.pack(self.origin_span))
             buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
@@ -256,6 +277,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             inp.read(cls._HDR.size)
         )
         locs = []
+        origin_span = 0
         end = len(payload)
         # locations are each >= 28 bytes, so a residue of exactly 8 is
         # the trailing trace-id extension (absent from legacy senders);
@@ -343,12 +365,21 @@ class PublishPartitionLocationsMsg(RpcMsg):
                                 ),
                             )
                     continue
+                if marker == cls._FLW_MARKER:
+                    for _ in range(count):
+                        (span,) = cls._FLW_ITEM.unpack(
+                            inp.read(cls._FLW_ITEM.size)
+                        )
+                        if span:
+                            origin_span = span
+                    continue
             inp.seek(pos)
             locs.append(PartitionLocation.read(inp))
         trace_id = 0
         if end - inp.tell() == cls._TRACE_EXT.size:
             (trace_id,) = cls._TRACE_EXT.unpack(inp.read(cls._TRACE_EXT.size))
-        return cls(shuffle_id, partition_id, locs, bool(is_last), num_maps, trace_id)
+        return cls(shuffle_id, partition_id, locs, bool(is_last), num_maps,
+                   trace_id, origin_span)
 
 
 @dataclass
@@ -371,17 +402,23 @@ class FetchPartitionLocationsMsg(RpcMsg):
     # a trailing 8-byte extension after the legacy 12-byte body; legacy
     # senders (examples/foreign_client.c) omit it and parse as trace 0.
     trace_id: int = 0
+    # observability: span id of the reducer-side fetch-request span
+    # (0 = none), a second trailing 8-byte extension after trace_id, so
+    # the driver's resolve span can causally follow the request. Legacy
+    # and trace-only senders omit it and parse as 0.
+    origin_span: int = 0
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         buf = BytesIO()
         self.requester.write(buf)
         buf.write(
             struct.pack(
-                ">iiiQ",
+                ">iiiQQ",
                 self.shuffle_id,
                 self.start_partition,
                 self.end_partition,
                 self.trace_id,
+                self.origin_span,
             )
         )
         seg = self.frame(self.msg_type, buf.getvalue())
@@ -396,7 +433,8 @@ class FetchPartitionLocationsMsg(RpcMsg):
         rest = inp.read()
         shuffle_id, start, end = struct.unpack_from(">iii", rest, 0)
         trace_id = struct.unpack_from(">Q", rest, 12)[0] if len(rest) >= 20 else 0
-        return cls(requester, shuffle_id, start, end, trace_id)
+        origin = struct.unpack_from(">Q", rest, 20)[0] if len(rest) >= 28 else 0
+        return cls(requester, shuffle_id, start, end, trace_id, origin)
 
 
 @dataclass

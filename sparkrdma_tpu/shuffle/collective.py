@@ -476,6 +476,19 @@ class ShuffleScheduleCompiler:
             "collective.wave_ms", role=self._executor_id,
             schedule=self._schedule_label,
         ).observe((time.perf_counter() - t0) * 1e3)
+        if self._tracer is not None:
+            # per-wave span (dma-wave attribution, obs/attr.py): nests
+            # under execute()'s shuffle.collective span via the
+            # contextvar parent, so the critical path can enter the
+            # wave level instead of one opaque multi-wave slice
+            self._tracer.record(
+                "shuffle.collective.wave",
+                t0,
+                time.perf_counter(),
+                shuffle_id=shuffle_id,
+                rows=live,
+                bytes=nbytes,
+            )
         return stacked_dev, dead, stacked
 
     # conf-resolved schedule of the plan currently executing (execute()

@@ -693,16 +693,36 @@ class TpuShuffleFetcherIterator:
         self._m_remote_bytes.inc(group.total_length)
         self._h_fetch_ms.observe(latency_ms)
         # fetch span: the trace id arrived with the location reply, so
-        # the binding is resolvable by now
-        self._manager.tracer.record(
+        # the binding is resolvable by now; it causally follows the
+        # driver resolve span whose reply named these locations
+        fsp = self._manager.tracer.record(
             "shuffle.fetch",
             t0,
             t1,
             shuffle_id=self._handle.shuffle_id,
+            follows=self._manager.resolve_origin(
+                self._handle.shuffle_id, self.start_partition
+            ),
             peer=mid.executor_id,
             bytes=group.total_length,
             blocks=len(streams),
         )
+        # native submission plane: drain the node's read-completion
+        # timestamp ring into transport.native_read spans, so the
+        # submit→complete interval inside this fetch window is traced
+        # (host-read attribution, obs/attr.py)
+        drain = getattr(getattr(self._manager, "node", None),
+                        "drain_read_ring", None)
+        if drain is not None:
+            for rt0, rt1, nbytes in drain():
+                self._manager.tracer.record(
+                    "transport.native_read",
+                    rt0,
+                    rt1,
+                    shuffle_id=self._handle.shuffle_id,
+                    follows=fsp,
+                    bytes=nbytes,
+                )
         self._put_success(streams, group.total_length)
 
     def _fetch_blocks(self, fetch: _PendingFetch) -> None:

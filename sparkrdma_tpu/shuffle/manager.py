@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from sparkrdma_tpu.analysis.lockorder import OrderedLock, named_lock
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
-from sparkrdma_tpu.obs import Tracer, get_registry, mint_trace_id
+from sparkrdma_tpu.obs import SpanHandle, Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
 from sparkrdma_tpu.obs.telemetry import TelemetryHub
 from sparkrdma_tpu.resilience import SourceHealthRegistry
@@ -119,6 +119,15 @@ class TpuShuffleManager:
         self._fetch_futures: Dict[Tuple[int, int], Future] = {}
         self._fetch_acc: Dict[Tuple[int, int], List[PartitionLocation]] = {}
         self._known_managers: List[ShuffleManagerId] = []
+        # critical-path attribution: span id of the driver's resolve
+        # span per (shuffle_id, start_partition), learned from the
+        # location reply's follows extension so the fetch spans it
+        # caused can declare the causal edge (obs/critpath.py)
+        self._resolve_origins: Dict[Tuple[int, int], SpanHandle] = {}
+        # driver side of the same chain: handles of the per-writer
+        # publish record spans, so resolve spans follow the publishes
+        # they serve (publish -> resolve -> fetch in the Perfetto DAG)
+        self._publish_origins: Dict[int, List[SpanHandle]] = {}
 
         # hot: dict lookups only (see _shuffle_locks comment above) —
         # the lock-order detector enforces that no blocking call runs
@@ -362,13 +371,16 @@ class TpuShuffleManager:
         self._reply_fetch(msg)
 
     def _reply_fetch(self, msg: FetchPartitionLocationsMsg) -> None:
+        with self._lock:
+            pub_origins = list(self._publish_origins.get(msg.shuffle_id, ()))
         with self.tracer.span(
             "shuffle.resolve",
             shuffle_id=msg.shuffle_id,
             trace_id=msg.trace_id,
+            follows=[SpanHandle(msg.trace_id, msg.origin_span)] + pub_origins,
             requester=msg.requester.executor_id,
             partitions=f"{msg.start_partition}:{msg.end_partition}",
-        ):
+        ) as rsp:
             locs: List[PartitionLocation] = []
             with self._shuffle_lock(msg.shuffle_id):
                 with self._lock:
@@ -381,6 +393,7 @@ class TpuShuffleManager:
                 msg.start_partition,
                 locs,
                 trace_id=self.tracer.trace_for(msg.shuffle_id) or msg.trace_id,
+                origin_span=rsp.span_id if rsp is not None else 0,
             )
             assert self.node is not None
             try:
@@ -420,15 +433,23 @@ class TpuShuffleManager:
             if msg.is_last and msg.partition_id < 0:
                 # one span per completed writer publish (not per segment)
                 t = obs_now()
-                self.tracer.record(
+                psp = self.tracer.record(
                     "shuffle.publish",
                     t,
                     t,
                     shuffle_id=msg.shuffle_id,
                     trace_id=msg.trace_id,
+                    follows=SpanHandle(msg.trace_id, msg.origin_span),
                     locations=len(msg.locations),
                     map_outputs=msg.num_map_outputs,
                 )
+                if psp is not None:
+                    with self._lock:
+                        origins = self._publish_origins.setdefault(
+                            msg.shuffle_id, []
+                        )
+                        if len(origins) < 256:  # bound per-shuffle growth
+                            origins.append(psp.handle())
             # replica publishes (elastic layer) divert whole into the
             # replica registry: they must never reach fetch replies or
             # the planner's byte totals until a promotion makes them
@@ -514,12 +535,26 @@ class TpuShuffleManager:
         key = (msg.shuffle_id, msg.partition_id)
         with self._lock:
             self._fetch_acc.setdefault(key, []).extend(msg.locations)
+            if msg.origin_span:
+                # the driver resolve span this reply hands off from;
+                # the fetch spans it causes follow it (resolve→fetch)
+                self._resolve_origins[key] = SpanHandle(
+                    msg.trace_id, msg.origin_span
+                )
             if not msg.is_last:
                 return
             locs = self._fetch_acc.pop(key, [])
             future = self._fetch_futures.pop(key, None)
         if future is not None:
             future.set_result(locs)
+
+    def resolve_origin(
+        self, shuffle_id: int, start_partition: int
+    ) -> Optional[SpanHandle]:
+        """Causal handle of the driver resolve span that answered this
+        (shuffle, range) location fetch, if the reply carried one."""
+        with self._lock:
+            return self._resolve_origins.get((shuffle_id, start_partition))
 
     def _on_peer_lost(self, executor_id: str) -> None:
         """Driver: prune a lost executor's locations (:199-221).
@@ -751,7 +786,11 @@ class TpuShuffleManager:
         assert self.node is not None
         with self.tracer.span(
             "shuffle.publish", shuffle_id=shuffle_id, locations=len(locations)
-        ):
+        ) as sp:
+            if sp is not None:
+                # the driver's publish record follows this span: the
+                # executor→driver leg of the cross-role critical path
+                msg.origin_span = sp.span_id
             ch = self.node.get_channel(self.conf.driver_host, self.conf.driver_port)
             ch.send_in_queue(FnListener(), msg.to_segments(self.conf.recv_wr_size))
 
@@ -780,10 +819,22 @@ class TpuShuffleManager:
                 pending.set_exception(e)
 
         try:
-            ch = self.node.get_channel(self.conf.driver_host, self.conf.driver_port)
-            ch.send_in_queue(
-                FnListener(None, on_fail), msg.to_segments(self.conf.recv_wr_size)
-            )
+            # the request span's handle rides the frame so the driver's
+            # resolve span follows it (request→resolve causal leg)
+            with self.tracer.span(
+                "shuffle.fetch_request",
+                shuffle_id=shuffle_id,
+                partitions=f"{start_partition}:{end_partition}",
+            ) as sp:
+                if sp is not None:
+                    msg.origin_span = sp.span_id
+                ch = self.node.get_channel(
+                    self.conf.driver_host, self.conf.driver_port
+                )
+                ch.send_in_queue(
+                    FnListener(None, on_fail),
+                    msg.to_segments(self.conf.recv_wr_size),
+                )
         except IOError as e:
             on_fail(e)
         return future
@@ -970,6 +1021,7 @@ class TpuShuffleManager:
             self._maps_by_exec.pop(shuffle_id, None)
             self._map_owner.pop(shuffle_id, None)
             self._replica_locations.pop(shuffle_id, None)
+            self._publish_origins.pop(shuffle_id, None)
             self._shuffle_locks.pop(shuffle_id, None)
 
     # ------------------------------------------------------------------

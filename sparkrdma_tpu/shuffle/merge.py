@@ -42,12 +42,13 @@ import re
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.locations import BlockLocation, PartitionLocation
-from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs import SpanHandle, get_registry
 from sparkrdma_tpu.shuffle.writer.blocks import MemoryWriterBlock
 from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.utils import checksum as _checksum
@@ -134,7 +135,7 @@ def plan_reads(
 class _ShuffleMergeState:
     """One shuffle's accumulation on one endpoint."""
 
-    __slots__ = ("blocks", "markers", "sealed", "abandoned")
+    __slots__ = ("blocks", "markers", "sealed", "abandoned", "push_origins")
 
     def __init__(self):
         # pid -> (source, seq) -> payload bytes
@@ -144,6 +145,9 @@ class _ShuffleMergeState:
         # pid -> registered segment block (None while sealing)
         self.sealed: Dict[int, Optional[MemoryWriterBlock]] = {}
         self.abandoned: Set[int] = set()
+        # source -> handle of the map-side push span (obs/trace.py):
+        # seal spans causally follow every contributing source's push
+        self.push_origins: Dict[str, SpanHandle] = {}
 
 
 class MergeEndpoint:
@@ -174,13 +178,18 @@ class MergeEndpoint:
         source: str,
         blocks: Sequence[Tuple[int, int, bytes]],
         final: Optional[dict] = None,
+        origin_span: int = 0,
+        origin_trace: int = 0,
     ) -> int:
         """Accept pushed ``(pid, seq, payload)`` blocks from ``source``.
 
         ``final`` (the source's finalize marker) carries
         ``{"counts": {pid: total}, "committed": n, "num_maps": m}``;
         seal checks run once markers account for every map output.
-        Returns the number of newly buffered blocks (dedup/budget drops
+        ``origin_span``/``origin_trace`` identify the sender's
+        ``shuffle.push`` span (the push→merge-seal causal seam,
+        obs/trace.py); 0 for legacy or untraced senders. Returns the
+        number of newly buffered blocks (dedup/budget drops
         excluded) — purely informational, pushes are fire-and-forget.
         """
         accepted = 0
@@ -190,6 +199,8 @@ class MergeEndpoint:
             if self._stopped:
                 return 0
             st = self._shuffles.setdefault(shuffle_id, _ShuffleMergeState())
+            if origin_span:
+                st.push_origins[source] = SpanHandle(origin_trace, origin_span)
             for pid, seq, payload in blocks or ():
                 if self._closed_locked(st, pid):
                     self._m_dedup.inc()
@@ -216,8 +227,9 @@ class MergeEndpoint:
                 )
             if st.markers:
                 to_seal = self._sealable_locked(st)
+            origins = list(st.push_origins.values()) if to_seal else []
         for pid, need, payloads in to_seal:
-            self._seal(shuffle_id, pid, need, payloads)
+            self._seal(shuffle_id, pid, need, payloads, origins)
         return accepted
 
     def _closed_locked(self, st: _ShuffleMergeState, pid: int) -> bool:
@@ -280,9 +292,11 @@ class MergeEndpoint:
         pid: int,
         need: List[Tuple[str, int]],
         payloads: Dict[Tuple[str, int], bytes],
+        origins: Optional[List[SpanHandle]] = None,
     ) -> None:
         """Concatenate coverage into one registered segment + publish."""
         schedule_point("proto", "merge.seal")
+        t_seal0 = time.perf_counter()
         manager = self._manager
         total = sum(len(payloads[k]) for k in need)
         admitted = total > 0 and manager.resolver.reserve_inmemory_bytes(total)
@@ -342,6 +356,21 @@ class MergeEndpoint:
                 merged_cover=len(need),
             ),
         )
+        # the seal span causally follows every contributing source's
+        # push span (push→merge-seal seam, obs/trace.py flow events);
+        # manager is duck-typed (modelcheck sinks carry no tracer)
+        tracer = getattr(manager, "tracer", None)
+        if tracer is not None:
+            tracer.record(
+                "shuffle.merge_seal",
+                t_seal0,
+                time.perf_counter(),
+                shuffle_id=shuffle_id,
+                follows=origins,
+                pid=pid,
+                bytes=total,
+                cover=len(need),
+            )
         # location-only publish: merged segments never touch the
         # map-output barrier; they only ADD a location class
         manager.publish_partition_locations(shuffle_id, -1, [loc], num_map_outputs=0)
@@ -427,16 +456,26 @@ class PushClient:
         dests = set(by_dest)
         if final is not None:
             dests.update(cands)
-        for dest in sorted(dests, key=_natural):
-            self._send(
-                dest,
-                {
-                    "shuffle_id": shuffle_id,
-                    "source": self._manager.executor_id,
-                    "blocks": by_dest.get(dest, []),
-                    "final": final,
-                },
-            )
+        with self._manager.tracer.span(
+            "shuffle.push",
+            shuffle_id=shuffle_id,
+            blocks=len(blocks or ()),
+            final=final is not None,
+        ) as sp:
+            for dest in sorted(dests, key=_natural):
+                self._send(
+                    dest,
+                    {
+                        "shuffle_id": shuffle_id,
+                        "source": self._manager.executor_id,
+                        "blocks": by_dest.get(dest, []),
+                        "final": final,
+                        # push→merge-seal causal seam (obs/trace.py):
+                        # the endpoint's seal span follows this span
+                        "origin_span": sp.span_id if sp is not None else 0,
+                        "origin_trace": sp.trace_id if sp is not None else 0,
+                    },
+                )
 
     def _send(self, dest: str, payload: dict) -> None:
         blocks = payload["blocks"]
@@ -450,7 +489,12 @@ class PushClient:
         try:
             if ep is not None:
                 ep.push_blocks(
-                    payload["shuffle_id"], payload["source"], blocks, payload["final"]
+                    payload["shuffle_id"],
+                    payload["source"],
+                    blocks,
+                    payload["final"],
+                    payload.get("origin_span", 0),
+                    payload.get("origin_trace", 0),
                 )
             elif dest in self.routes:
                 self._send_socket(self.routes[dest], payload)

@@ -56,7 +56,7 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
-from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs import get_registry, get_tracer
 from sparkrdma_tpu.shuffle.writer.pipeline import PipelineReport, _STAGE_BOUNDS
 
 STAGES = ("fetch", "decode", "stage", "merge")
@@ -152,10 +152,18 @@ class ReduceTaskPipeline:
                     errbox.append(e)
             abort.set()
 
-        def timed(stage: str, fn: Callable, *args) -> Any:
+        tracer = get_tracer(self._role)
+
+        def timed(stage: str, follows, fn: Callable, *args):
+            """Run one stage body inside a ``reader.pipeline.<stage>``
+            span that causally follows the item's previous stage span
+            (the queue hand-off edge). Returns (result, span)."""
             t0 = time.perf_counter()
             try:
-                return fn(*args)
+                with tracer.span(
+                    "reader.pipeline." + stage, follows=follows
+                ) as sp:
+                    return fn(*args), sp
             finally:
                 dt = time.perf_counter() - t0
                 hists[stage].observe(dt * 1e3)
@@ -208,17 +216,17 @@ class ReduceTaskPipeline:
                             busy["fetch"] += dt
                     inflight.add(1)
                     try:
-                        fetched = (
-                            timed("fetch", self._fetch_fn, item)
+                        fetched, sp = (
+                            timed("fetch", None, self._fetch_fn, item)
                             if self._fetch_fn is not None
-                            else item
+                            else (item, None)
                         )
                     except BaseException as e:  # noqa: BLE001
                         fail(e)
                         inflight.add(-1)
                         break
                     schedule_point("queue", "reader.decode_q.put")
-                    decode_q.put((idx, item, fetched))
+                    decode_q.put((idx, item, fetched, sp))
                     idx += 1
             except BaseException as e:  # noqa: BLE001
                 fail(e)
@@ -235,23 +243,23 @@ class ReduceTaskPipeline:
                 if got is _CLOSE:
                     decode_q.put(_CLOSE)  # release sibling workers
                     return
-                idx, item, fetched = got
+                idx, item, fetched, prev = got
                 if abort.is_set():
                     discard("fetch", item, fetched)
-                    decoded = _SKIP
+                    decoded, sp = _SKIP, None
                 else:
                     try:
-                        decoded = (
-                            timed("decode", self._decode_fn, item, fetched)
+                        decoded, sp = (
+                            timed("decode", prev, self._decode_fn, item, fetched)
                             if self._decode_fn is not None
-                            else fetched
+                            else (fetched, prev)
                         )
                     except BaseException as e:  # noqa: BLE001
                         fail(e)
                         discard("fetch", item, fetched)
-                        decoded = _SKIP
+                        decoded, sp = _SKIP, None
                 with seq_ready:
-                    seq_buf[idx] = (item, decoded)
+                    seq_buf[idx] = (item, decoded, sp)
                     seq_ready.notify_all()
 
         def next_in_order():
@@ -263,9 +271,9 @@ class ReduceTaskPipeline:
             with seq_ready:
                 while True:
                     if want in seq_buf:
-                        item, decoded = seq_buf.pop(want)
+                        item, decoded, sp = seq_buf.pop(want)
                         next_in_order.want = want + 1
-                        return want, item, decoded
+                        return want, item, decoded, sp
                     n = total_box["n"]
                     if n is not None and want >= n:
                         return None
@@ -273,31 +281,31 @@ class ReduceTaskPipeline:
 
         next_in_order.want = 0
 
-        def stage_one(idx, item, decoded):
+        def stage_one(idx, item, decoded, prev):
             if decoded is _SKIP or abort.is_set():
                 discard("decode", item, decoded)
-                return None, False
+                return None, None, False
             try:
-                staged = (
-                    timed("stage", self._stage_fn, item, decoded)
+                staged, sp = (
+                    timed("stage", prev, self._stage_fn, item, decoded)
                     if self._stage_fn is not None
-                    else decoded
+                    else (decoded, prev)
                 )
-                return staged, True
+                return staged, sp, True
             except BaseException as e:  # noqa: BLE001
                 fail(e)
                 discard("decode", item, decoded)
-                return None, False
+                return None, None, False
 
-        def merge_one(idx, item, staged) -> None:
+        def merge_one(idx, item, staged, prev) -> None:
             if abort.is_set():
                 discard("stage", item, staged)
                 return
             try:
-                out = (
-                    timed("merge", self._merge_fn, item, staged)
+                out, _sp = (
+                    timed("merge", prev, self._merge_fn, item, staged)
                     if self._merge_fn is not None
-                    else staged
+                    else (staged, prev)
                 )
             except BaseException as e:  # noqa: BLE001
                 fail(e)
@@ -313,17 +321,17 @@ class ReduceTaskPipeline:
                     if self._double_buffer:
                         merge_q.put(_CLOSE)
                     return
-                idx, item, decoded = nxt
-                staged, ok = stage_one(idx, item, decoded)
+                idx, item, decoded, prev = nxt
+                staged, sp, ok = stage_one(idx, item, decoded, prev)
                 if not ok:
                     continue
                 if self._double_buffer:
                     # hand off: the NEXT item's host->HBM stage fills
                     # its buffer while the merge thread drains this one
                     schedule_point("queue", "reader.merge_q.put")
-                    merge_q.put((idx, item, staged))
+                    merge_q.put((idx, item, staged, sp))
                 else:
-                    merge_one(idx, item, staged)
+                    merge_one(idx, item, staged, sp)
 
         def merge_main() -> None:
             while True:

@@ -46,7 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
-from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs import get_registry, get_tracer
 
 STAGES = ("sort", "stage", "publish")
 
@@ -129,10 +129,18 @@ class MapTaskPipeline:
                     errbox.append(e)
             abort.set()
 
-        def timed(stage: str, fn: Callable, *args) -> Any:
+        tracer = get_tracer(self._role)
+
+        def timed(stage: str, follows, fn: Callable, *args):
+            """Run one stage body inside a ``writer.pipeline.<stage>``
+            span that causally follows the item's previous stage span
+            (the queue hand-off edge). Returns (result, span)."""
             t0 = time.perf_counter()
             try:
-                return fn(*args)
+                with tracer.span(
+                    "writer.pipeline." + stage, follows=follows
+                ) as sp:
+                    return fn(*args), sp
             finally:
                 dt = time.perf_counter() - t0
                 hists[stage].observe(dt * 1e3)
@@ -150,16 +158,16 @@ class MapTaskPipeline:
                 if abort.is_set():
                     inflight.add(-1)
                     return
-                out = (
-                    timed("sort", self._sort_fn, items[idx])
+                out, sp = (
+                    timed("sort", None, self._sort_fn, items[idx])
                     if self._sort_fn is not None
-                    else items[idx]
+                    else (items[idx], None)
                 )
                 # blocking put IS the backpressure; an abort raised
                 # downstream closes the queues only after draining, so
                 # this never deadlocks
                 schedule_point("queue", "writer.stage_q.put")
-                stage_q.put((idx, out))
+                stage_q.put((idx, out, sp))
             except BaseException as e:  # noqa: BLE001 — latch and drain
                 inflight.add(-1)
                 fail(e)
@@ -171,18 +179,18 @@ class MapTaskPipeline:
                 if got is _CLOSE:
                     publish_q.put(_CLOSE)
                     return
-                idx, sorted_out = got
+                idx, sorted_out, prev = got
                 if abort.is_set():
                     inflight.add(-1)
                     continue
                 try:
-                    staged = (
-                        timed("stage", self._stage_fn, items[idx], sorted_out)
+                    staged, sp = (
+                        timed("stage", prev, self._stage_fn, items[idx], sorted_out)
                         if self._stage_fn is not None
-                        else sorted_out
+                        else (sorted_out, prev)
                     )
                     schedule_point("queue", "writer.publish_q.put")
-                    publish_q.put((idx, staged))
+                    publish_q.put((idx, staged, sp))
                 except BaseException as e:  # noqa: BLE001
                     inflight.add(-1)
                     fail(e)
@@ -193,13 +201,13 @@ class MapTaskPipeline:
                 got = publish_q.get()
                 if got is _CLOSE:
                     return
-                idx, staged = got
+                idx, staged, prev = got
                 if abort.is_set():
                     inflight.add(-1)
                     continue
                 try:
                     results[idx] = (
-                        timed("publish", self._publish_fn, items[idx], staged)
+                        timed("publish", prev, self._publish_fn, items[idx], staged)[0]
                         if self._publish_fn is not None
                         else staged
                     )

@@ -31,7 +31,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Deque, Dict, Optional, Tuple
 
 from sparkrdma_tpu.analysis.lockorder import named_lock
-from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs import get_registry, get_tracer
 from sparkrdma_tpu.tenancy import current_tenant, tenant_scope
 
 logger = logging.getLogger(__name__)
@@ -77,6 +77,7 @@ class FairShareExecutor:
         self._h_wait: Dict[str, Any] = {}
         self._g_queued: Dict[str, Any] = {}
         self._reg = reg
+        self._tracer = get_tracer("fairshare")
         self._threads = [
             threading.Thread(
                 target=self._worker,
@@ -154,8 +155,18 @@ class FairShareExecutor:
             fut, fn, args, kwargs, tenant, t_submit = item
             if not fut.set_running_or_notify_cancel():
                 continue
+            t_dispatch = time.perf_counter()
             self._metric(self._h_wait, "histogram", "tenant.wait_ms", tenant).observe(
-                (time.perf_counter() - t_submit) * 1e3
+                (t_dispatch - t_submit) * 1e3
+            )
+            # queue-wait attribution span (obs/attr.py): the submit→
+            # dispatch interval this task spent parked behind DRR
+            self._tracer.record(
+                "tenant.queue_wait",
+                t_submit,
+                t_dispatch,
+                tenant=tenant,
+                pool=self._pool_label,
             )
             t0 = time.perf_counter()
             with tenant_scope(tenant):

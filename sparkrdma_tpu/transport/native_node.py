@@ -23,7 +23,8 @@ import os
 import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -261,6 +262,27 @@ class NativeTpuChannel:
 
         return FnListener(ok, err)
 
+    def _ring_wrap(self, listener: Optional[CompletionListener], nbytes: int):
+        """Stamp the READ's submit→complete interval into the node's
+        timestamp ring (critical-path attribution, obs/critpath.py):
+        the native data plane is otherwise span-dark — completions fire
+        on the C++ epoll loop with no Python frame to trace."""
+        from sparkrdma_tpu.transport.completion import FnListener
+
+        t0 = time.perf_counter()
+        ring = self._node._read_ring
+
+        def ok(payload):
+            ring.append((t0, time.perf_counter(), nbytes))
+            if listener:
+                listener.on_success(payload)
+
+        def err(e):
+            if listener:
+                listener.on_failure(e)
+
+        return FnListener(ok, err)
+
     # -- verb API (parity with TpuChannel) -----------------------------
     def send_in_queue(self, listener: CompletionListener, segments: Sequence[bytes]) -> None:
         plan = _faults.active()
@@ -296,7 +318,7 @@ class NativeTpuChannel:
         self._m_reads.inc(len(blocks))
         self._m_read_bytes.inc(total)
         permits = max(1, len(blocks))
-        wrapped = self._wrap_reclaim(listener, permits)
+        wrapped = self._wrap_reclaim(self._ring_wrap(listener, total), permits)
         def post():
             self._node._post_read(self, wrapped, dst_views, blocks)
 
@@ -319,10 +341,11 @@ class NativeTpuChannel:
             listener, handled = plan.on_read(self, listener, None, blocks)
             if handled:
                 return
+        total = sum(b[2] for b in blocks)
         self._m_reads.inc(len(blocks))
-        self._m_read_bytes.inc(sum(b[2] for b in blocks))
+        self._m_read_bytes.inc(total)
         permits = max(1, len(blocks))
-        wrapped = self._wrap_reclaim(listener, permits)
+        wrapped = self._wrap_reclaim(self._ring_wrap(listener, total), permits)
         def post():
             self._node._post_read_mapped(self, wrapped, blocks)
 
@@ -389,6 +412,11 @@ class NativeTpuNode:
         # outstanding work requests: wr_id -> (listener, keepalive)
         self._wrs: Dict[int, Tuple[CompletionListener, object]] = {}
         self._next_wr = 1
+        # READ submit→complete timestamp ring (bounded; appended from
+        # completion threads, drained by the fetcher into
+        # ``transport.native_read`` spans — obs/critpath.py host-read
+        # attribution). deque ops are atomic, so no extra lock.
+        self._read_ring: Deque[Tuple[float, float, int]] = deque(maxlen=4096)
         # mapped READs in flight: wr_id -> block lengths (for slicing a
         # streamed-fallback blob back into per-block views)
         self._mapped_wrs: Dict[int, List[int]] = {}
@@ -861,6 +889,19 @@ class NativeTpuNode:
                 self._channels[cid] = ch
                 self._active[key] = ch
             return ch
+
+    def drain_read_ring(self) -> List[Tuple[float, float, int]]:
+        """Pop and return every buffered READ ``(t_submit, t_complete,
+        nbytes)`` stamp (oldest first). Consumers turn these into
+        ``transport.native_read`` spans; the ring is bounded, so stamps
+        nobody drains age out instead of accumulating."""
+        out: List[Tuple[float, float, int]] = []
+        ring = self._read_ring
+        while True:
+            try:
+                out.append(ring.popleft())
+            except IndexError:
+                return out
 
     def read_path_stats(self) -> Tuple[int, int]:
         """(file_fast_path_reads, streamed_reads) completed by this

@@ -67,6 +67,7 @@ DECLARED_KNOBS: Dict[str, str] = {
     "fetchTimeBucketSizeInMs": "reader stats: bucket width",
     "obs.traceEnabled": "record spans in the per-role tracers",
     "obs.traceMaxSpans": "retained spans per tracer",
+    "obs.critpath.enabled": "per-job critical-path TimeBreakdown",
     "obs.telemetry.enabled": "heartbeat loops + driver TelemetryHub",
     "obs.telemetry.intervalMs": "heartbeat period / ring bucket width",
     "obs.telemetry.ringSize": "windows retained per executor",
@@ -319,6 +320,13 @@ class TpuShuffleConf:
     def trace_max_spans(self) -> int:
         """Bound on retained spans per tracer (oldest evicted first)."""
         return self._int("obs.traceMaxSpans", 20000, 100, 1 << 24)
+
+    @property
+    def critpath_enabled(self) -> bool:
+        """Build the per-job critical-path TimeBreakdown after every
+        ``run_job`` (obs/critpath.py / obs/attr.py). Requires span
+        recording; a no-op when ``obs.traceEnabled`` is false."""
+        return self._bool("obs.critpath.enabled", True)
 
     # -- cluster telemetry plane (obs/telemetry.py) -----------------------
     @property
