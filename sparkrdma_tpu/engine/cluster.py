@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.engine.worker import _recv_obj, _send_obj
+from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner, Partitioner
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
 from sparkrdma_tpu.utils.config import TpuShuffleConf
@@ -79,6 +80,10 @@ class ClusterContext:
         self._pool = ThreadPoolExecutor(max_workers=max(4, num_executors * 2))
         # last finished job's critical-path verdict (obs/attr.py)
         self.last_breakdown = None
+        # driver-process sampler: workers run their own (engine/worker.py)
+        # and ship tables in heartbeats; the driver's feeds gap-frame
+        # annotation and is folded into the hub by the poll loop below
+        self.profiler = acquire_profiler(self.conf, role="driver")
 
         conf_json = json.dumps(self.conf.to_dict())  # includes driverPort
         for i in range(num_executors):
@@ -136,6 +141,8 @@ class ClusterContext:
                     continue
                 for p in payloads or []:
                     hub.ingest(p)
+            # the driver's own profile table joins the cluster merge
+            hub.profiles.ingest_local(self.profiler, "driver")
             hub.check_missed()
 
     def _next_shuffle_id(self) -> int:
@@ -483,6 +490,8 @@ class ClusterContext:
             except subprocess.TimeoutExpired:
                 w.proc.kill()
         self._pool.shutdown(wait=False)
+        release_profiler(self.profiler)
+        self.profiler = None
         self.driver.stop()
 
     def __enter__(self) -> "ClusterContext":

@@ -24,6 +24,7 @@ from sparkrdma_tpu.engine.rdd import (
     ShuffledRDD,
 )
 from sparkrdma_tpu.obs.metrics import get_registry
+from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
 from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.errors import ShuffleError
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle
@@ -70,6 +71,10 @@ class TpuContext:
         # last finished job's critical-path attribution verdict
         # (obs/attr.py TimeBreakdown), surfaced via metrics_snapshot()
         self.last_breakdown = None
+        # continuous profiling (obs/profiler.py): one refcounted sampler
+        # for the whole process — the in-process topology shares every
+        # thread, so its table rides the FIRST executor's heartbeat
+        self.profiler = acquire_profiler(self.conf, role="proc")
         # in-process topology: heartbeats push straight into the driver
         # hub (no control-plane hop); each executor samples its own
         # role-filtered view of the shared process registry
@@ -85,6 +90,8 @@ class TpuContext:
                         match={"role": executor.executor_id},
                     ).start()
                 )
+            if self.heartbeaters:
+                self.heartbeaters[0].attach_profiler(self.profiler)
 
     # ------------------------------------------------------------------
     def _next_rdd_id(self) -> int:
@@ -387,6 +394,8 @@ class TpuContext:
         self._pool.shutdown(wait=True)
         for hb in self.heartbeaters:
             hb.stop(flush=True)  # final delta lands in the hub
+        release_profiler(self.profiler)
+        self.profiler = None
         for executor in self.executors:
             executor.stop()
         self.driver.stop()

@@ -36,6 +36,7 @@ import cloudpickle
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs.metrics import get_registry
+from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
 from sparkrdma_tpu.obs.telemetry import Heartbeater
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
 from sparkrdma_tpu.testing import faults as _faults
@@ -77,6 +78,9 @@ class Worker:
         # cancel_reduce request can fire the pipeline's abort latch
         self._reduces: dict = {}
         self._reduce_lock = threading.Lock()
+        # continuous profiling: this process's wall-clock sampler; its
+        # collapsed-stack tables ride the heartbeat payloads below
+        self.profiler = acquire_profiler(conf, role=executor_id)
         # outbox-mode heartbeater: samples role-filtered registry deltas
         # on a timer; the driver pulls them with {"kind": "telemetry"}
         self.heartbeater = None
@@ -86,6 +90,7 @@ class Worker:
                 executor_id,
                 interval_ms=conf.telemetry_interval_ms,
                 match={"role": executor_id},
+                profiler=self.profiler,
             ).start()
 
     def _run_map(self, handle, map_id, records_fn) -> None:
@@ -296,6 +301,8 @@ class Worker:
         srv.close()
         if self.heartbeater is not None:
             self.heartbeater.stop(flush=False)  # nobody left to pull
+        release_profiler(self.profiler)
+        self.profiler = None
         self.manager.stop()
 
 

@@ -24,6 +24,14 @@ from sparkrdma_tpu.obs.metrics import (
     snapshot_delta,
     strip_label,
 )
+from sparkrdma_tpu.obs.profiler import (
+    ProfileHub,
+    SamplingProfiler,
+    acquire_profiler,
+    get_profiler,
+    release_profiler,
+    render_flamegraph_html,
+)
 from sparkrdma_tpu.obs.telemetry import Heartbeater, TelemetryHub
 from sparkrdma_tpu.obs.timeseries import TimeSeriesRing, Window
 from sparkrdma_tpu.obs.trace import (
@@ -47,23 +55,29 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "OpenMetricsServer",
+    "ProfileHub",
+    "SamplingProfiler",
     "Span",
     "SpanHandle",
     "TelemetryHub",
     "TimeSeriesRing",
     "Tracer",
     "Window",
+    "acquire_profiler",
     "all_tracers",
     "collect_spans",
     "collect_spans_with_epochs",
     "export_chrome_trace",
     "extract_snapshot",
+    "get_profiler",
     "get_registry",
     "get_tracer",
     "metric_key",
     "mint_trace_id",
     "now",
     "parse_metric_key",
+    "release_profiler",
+    "render_flamegraph_html",
     "render_openmetrics",
     "snapshot_delta",
     "strip_label",

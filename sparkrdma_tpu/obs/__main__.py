@@ -17,6 +17,13 @@ from a registry snapshot saved inside a bench/workload artifact JSON.
 critical-path attribution (obs/critpath.py) over a saved Chrome trace,
 or prints the ``breakdown`` stored in a bench/flight artifact.
 
+Continuous profiling (obs/profiler.py): ``--demo`` runs under the
+default sampling profiler, and ``--flamegraph [DEST]`` /
+``--folded [DEST]`` render the merged samples as a self-contained HTML
+flamegraph / collapsed-stack text ('-' = stdout). Saved flight records
+carry per-executor profile windows, so both flags also accept
+``--from-snapshot FLIGHT.json`` as their sample source.
+
 The demo is jax-free: it exercises the host shuffle planes (transport,
 rpc, writer, mempool, reader) only.
 """
@@ -29,9 +36,11 @@ import sys
 
 from sparkrdma_tpu.obs import export_chrome_trace, get_registry
 from sparkrdma_tpu.obs.export import extract_snapshot, render_openmetrics
+from sparkrdma_tpu.obs.profiler import ProfileHub
 
 
-def _run_demo() -> None:
+def _run_demo() -> "ProfileHub":
+    from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
     from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
     from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
     from sparkrdma_tpu.utils.config import TpuShuffleConf
@@ -41,8 +50,12 @@ def _run_demo() -> None:
             "tpu.shuffle.shuffleWriteMethod": "wrapper",
             "tpu.shuffle.shuffleWriteBlockSize": "65536",
             "tpu.shuffle.shuffleReadBlockSize": "65536",
+            # sample fast enough that even this sub-second demo folds a
+            # non-trivial profile (default 19 Hz targets long-lived jobs)
+            "tpu.shuffle.obs.profile.hz": "199",
         }
     )
+    profiler = acquire_profiler(conf, role="proc")
     driver = TpuShuffleManager(conf, is_driver=True)
     ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
     ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")
@@ -61,10 +74,16 @@ def _run_demo() -> None:
         for ex, (lo, hi) in [(ex0, (0, 1)), (ex1, (1, 2))]:
             for _ in ex.get_reader(handle, lo, hi).read():
                 pass
+        if profiler is not None:
+            profiler.sample_once()  # at least one sample, however fast
     finally:
         ex0.stop()
         ex1.stop()
         driver.stop()
+    hub = ProfileHub()
+    hub.ingest_local(profiler, "proc")
+    release_profiler(profiler)
+    return hub
 
 
 def _print_flight(path: str) -> int:
@@ -99,8 +118,29 @@ def _print_flight(path: str) -> int:
         if wins:
             span = f", wall {wins[0]['wall_ms']}..{wins[-1]['wall_ms']}"
         print(f"    {eid}: {len(wins)} windows, {gaps} gaps{span}")
+    profiles = doc.get("profiles") or {}
+    if profiles:
+        print("  last profile window per executor (obs/profiler.py):")
+        for eid in sorted(profiles):
+            win = profiles[eid]
+            rows = sorted(win.get("rows") or [], key=lambda r: -r[3])
+            total = sum(r[3] for r in rows)
+            hz = win.get("hz") or 0
+            print(f"    {eid}: {total} samples @ {hz:g} Hz")
+            for tenant, cat, stack, n in rows[:3]:
+                leaf = ";".join(stack.split(";")[-2:])
+                print(f"      {n:6d}  [{tenant}|{cat}] {leaf}")
     print(f"  spans captured: {len(doc.get('spans') or [])}")
     return 0
+
+
+def _hub_from_flight(doc: dict) -> ProfileHub:
+    """Rebuild a ProfileHub from a flight record's profile windows."""
+    hub = ProfileHub()
+    for eid, win in (doc.get("profiles") or {}).items():
+        hub.ingest(eid, {"hz": win.get("hz"), "rows": win.get("rows")},
+                   wall_ms=win.get("wall_ms"))
+    return hub
 
 
 def _print_critical_path(path: str, top: int = 12) -> int:
@@ -193,14 +233,50 @@ def main(argv=None) -> int:
         "a saved Chrome trace (traceEvents) or from the 'breakdown' stored "
         "in a bench/flight artifact, then exit",
     )
+    ap.add_argument(
+        "--flamegraph", nargs="?", const="-", default=None, metavar="DEST",
+        help="render the merged profile samples (from --demo, or the "
+        "profile windows of a flight record given via --from-snapshot) as "
+        "a self-contained HTML flamegraph; DEST is a file path or '-'",
+    )
+    ap.add_argument(
+        "--folded", nargs="?", const="-", default=None, metavar="DEST",
+        help="like --flamegraph but emit flamegraph.pl collapsed-stack "
+        "text (executor;tenant:..;span:..;frames count)",
+    )
     args = ap.parse_args(argv)
 
     if args.flight_recorder:
         return _print_flight(args.flight_recorder)
     if args.critical_path:
         return _print_critical_path(args.critical_path)
+    hub = None
     if args.demo:
-        _run_demo()
+        hub = _run_demo()
+    if args.flamegraph is not None or args.folded is not None:
+        if hub is None and args.from_snapshot:
+            with open(args.from_snapshot, "r", encoding="utf-8") as f:
+                hub = _hub_from_flight(json.load(f))
+        if hub is None or not hub.total_samples:
+            print("no profile samples: run with --demo, or point "
+                  "--from-snapshot at a flight record with profile "
+                  "windows", file=sys.stderr)
+            return 2
+        for dest, text in (
+            (args.folded, hub.folded()),
+            (args.flamegraph,
+             hub.flamegraph_html(title="sparkrdma_tpu profile")),
+        ):
+            if dest is None:
+                continue
+            if dest == "-":
+                sys.stdout.write(text)
+            else:
+                with open(dest, "w", encoding="utf-8") as f:
+                    f.write(text)
+                print(f"wrote {dest} ({hub.total_samples} samples, "
+                      f"{len(hub.merged_rows())} stacks)")
+        return 0
     if args.trace_out:
         export_chrome_trace(args.trace_out)
     if args.openmetrics is not None:

@@ -24,7 +24,7 @@ Stdlib-only and jax-free, like the rest of ``obs/``.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from sparkrdma_tpu.obs.critpath import CriticalPath
 
@@ -79,19 +79,26 @@ def classify(name: str) -> str:
 
 
 class TimeBreakdown:
-    """One job's attribution verdict: wall, per-category ms, coverage."""
+    """One job's attribution verdict: wall, per-category ms, coverage.
 
-    __slots__ = ("wall_ms", "categories", "coverage", "critical_path")
+    ``gap_frames`` aggregates the sampling profiler's dominant frames
+    across every gap segment (``obs/profiler.py::annotate_gaps``) —
+    empty when no profiler was live for the job."""
+
+    __slots__ = ("wall_ms", "categories", "coverage", "critical_path",
+                 "gap_frames")
 
     def __init__(self, wall_ms: float, categories: Dict[str, float],
-                 coverage: float, critical_path: List[dict]):
+                 coverage: float, critical_path: List[dict],
+                 gap_frames: Optional[Dict[str, int]] = None):
         self.wall_ms = wall_ms
         self.categories = categories
         self.coverage = coverage
         self.critical_path = critical_path
+        self.gap_frames = gap_frames or {}
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "wall_ms": round(self.wall_ms, 3),
             "coverage": round(self.coverage, 4),
             "categories_ms": {
@@ -99,6 +106,10 @@ class TimeBreakdown:
             },
             "critical_path": self.critical_path,
         }
+        if self.gap_frames:
+            out["gap_frames"] = dict(sorted(
+                self.gap_frames.items(), key=lambda kv: -kv[1]))
+        return out
 
     def render(self) -> str:
         """Fixed-width table for CLIs and logs."""
@@ -110,15 +121,22 @@ class TimeBreakdown:
             if ms <= 0.0:
                 continue
             lines.append(f"  {cat:<16} {ms:10.3f} ms  {ms / wall * 100:5.1f}%")
+        if self.gap_frames:
+            top = sorted(self.gap_frames.items(), key=lambda kv: -kv[1])[:3]
+            lines.append("  gap frames: " + ", ".join(
+                f"{frame} ({n})" for frame, n in top))
         return "\n".join(lines)
 
 
 def attribute(path: CriticalPath, top_segments: int = 12) -> TimeBreakdown:
     """Fold a critical path into the category verdict."""
     cats: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+    gap_frames: Dict[str, int] = {}
     for seg in path.segments:
         cat = IDLE if seg.kind == "gap" else classify(seg.name)
         cats[cat] += seg.dur_s * 1e3
+        for frame, n in (getattr(seg, "frames", None) or ()):
+            gap_frames[frame] = gap_frames.get(frame, 0) + int(n)
     # traced-category coverage: everything except the idle bucket,
     # normalized to wall — the ≥90% acceptance gate reads this
     wall_ms = path.wall_s * 1e3
@@ -129,4 +147,5 @@ def attribute(path: CriticalPath, top_segments: int = 12) -> TimeBreakdown:
         {k: v for k, v in cats.items() if v > 0.0},
         min(1.0, coverage),
         [s.to_dict() for s in path.top_segments(top_segments)],
+        gap_frames,
     )

@@ -90,9 +90,15 @@ def place_spans(spans: Iterable,
 
 class Seg:
     """One critical-path segment: ``[t0, t1]`` attributed to one span
-    (``kind == "span"``) or to nothing (``kind == "gap"``)."""
+    (``kind == "span"``) or to nothing (``kind == "gap"``).
 
-    __slots__ = ("kind", "name", "role", "span_id", "t0", "t1")
+    Gap segments may carry ``frames`` — the dominant leaf frames the
+    sampling profiler observed inside the gap interval
+    (``obs/profiler.py::annotate_gaps``), as ``[[frame, count], ...]``
+    — turning "idle-untraced" into "what the CPU was actually doing".
+    """
+
+    __slots__ = ("kind", "name", "role", "span_id", "t0", "t1", "frames")
 
     def __init__(self, kind: str, name: str, role: str, span_id: int,
                  t0: float, t1: float):
@@ -102,17 +108,21 @@ class Seg:
         self.span_id = span_id
         self.t0 = t0
         self.t1 = t1
+        self.frames: Optional[List[list]] = None
 
     @property
     def dur_s(self) -> float:
         return max(0.0, self.t1 - self.t0)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "kind": self.kind, "name": self.name, "role": self.role,
             "span_id": self.span_id,
             "ms": round(self.dur_s * 1e3, 3),
         }
+        if self.frames:
+            out["frames"] = self.frames
+        return out
 
 
 class CriticalPath:
@@ -284,6 +294,7 @@ def job_breakdown(job_span, spans: Optional[Sequence] = None,
     the ``critpath.*`` build metrics. ``spans`` defaults to every live
     tracer's spans (in-process cluster)."""
     from sparkrdma_tpu.obs.attr import attribute
+    from sparkrdma_tpu.obs.profiler import annotate_gaps
     from sparkrdma_tpu.obs.trace import collect_spans
 
     t_build0 = time.perf_counter()
@@ -291,6 +302,9 @@ def job_breakdown(job_span, spans: Optional[Sequence] = None,
         spans = collect_spans()
     path = extract(spans, job_span.start, job_span.end,
                    exclude={job_span.span_id})
+    # gap segments get their dominant sampled frames BEFORE attribution
+    # folds segments into dicts (no-op without a live process profiler)
+    annotate_gaps(path)
     verdict = attribute(path)
     reg = get_registry()
     reg.counter("critpath.builds", role=role).inc()

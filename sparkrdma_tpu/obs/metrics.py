@@ -65,6 +65,11 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "critpath.builds": ("counter", _L({"role"})),
     "critpath.build_ms": ("histogram", _L({"role"})),
     "critpath.coverage_pct": ("gauge", _L()),
+    # continuous profiling plane (obs/profiler.py)
+    "profile.samples": ("counter", _L({"role"})),
+    "profile.dropped": ("counter", _L({"role"})),
+    "profile.overhead_ms": ("counter", _L({"role"})),
+    "profile.stacks": ("gauge", _L({"role"})),
     # device fetch plane (shuffle/device_fetch.py, device_io.py)
     "device_fetch.bytes": ("counter", _L()),
     "device_fetch.stage_ms": ("histogram", _L()),

@@ -54,6 +54,7 @@ from sparkrdma_tpu.obs.metrics import (
     snapshot_delta,
     strip_label,
 )
+from sparkrdma_tpu.obs.profiler import ProfileHub, SamplingProfiler
 from sparkrdma_tpu.obs.timeseries import TimeSeriesRing
 
 logger = logging.getLogger(__name__)
@@ -117,6 +118,7 @@ class Heartbeater:
         match: Optional[Mapping[str, str]] = None,
         outbox_size: int = 256,
         clock: Callable[[], float] = time.time,
+        profiler: Optional[SamplingProfiler] = None,
     ):
         self._registry = registry
         self.executor_id = executor_id
@@ -124,6 +126,7 @@ class Heartbeater:
         self._send = send
         self._match = dict(match) if match else None
         self._clock = clock
+        self._profiler = profiler
         self._outbox: "deque[dict]" = deque(maxlen=max(1, outbox_size))
         self._lock = threading.Lock()
         self._prev = registry.snapshot(self._match)
@@ -165,6 +168,12 @@ class Heartbeater:
                 if h["count"]
             },
         }
+        # continuous-profiling piggyback: the collapsed-stack table
+        # folded since the last beat rides the same payload/pull path
+        if self._profiler is not None:
+            profile = self._profiler.drain()
+            if profile:
+                payload["profile"] = profile
         if self._send is not None:
             try:
                 self._send(payload)
@@ -182,6 +191,11 @@ class Heartbeater:
                 out.append(self._outbox.popleft())
             except IndexError:
                 return out
+
+    def attach_profiler(self, profiler: Optional[SamplingProfiler]) -> None:
+        """Piggyback a sampling profiler's drained collapsed-stack
+        table onto every subsequent beat (``payload["profile"]``)."""
+        self._profiler = profiler
 
     def pause(self) -> None:
         with self._lock:
@@ -308,6 +322,9 @@ class TelemetryHub:
         self._last_file_write_ms = 0
         self.last_flight_path: Optional[str] = None
         self.last_flight: Optional[dict] = None
+        # cluster-wide merge of the executors' collapsed-stack profile
+        # tables (heartbeat "profile" payloads, obs/profiler.py)
+        self.profiles = ProfileHub(clock=clock)
 
         reg = self._registry
         self._g_executors = reg.gauge("telemetry.executors", role=role)
@@ -398,6 +415,12 @@ class TelemetryHub:
             histograms=payload.get("histograms"),
             gap=gap,
         )
+        profile = payload.get("profile")
+        if profile:
+            try:
+                self.profiles.ingest(exec_id, profile, wall_ms=wall_ms)
+            except (KeyError, TypeError, ValueError):
+                self._c_bad.inc()
         self._registry.counter(
             "telemetry.heartbeats", role=self.role, executor=exec_id
         ).inc()
@@ -476,6 +499,7 @@ class TelemetryHub:
             "executors": execs,
             "stragglers": list(self._last_report.get("stragglers", [])),
             "missed_heartbeats": self._g_missed.value,
+            "profile": self.profiles.summary(),
         }
 
     # -- straggler / skew detection ------------------------------------
@@ -649,6 +673,11 @@ class TelemetryHub:
                 self._health.states() if self._health is not None else {}
             ),
         }
+        # last profile window per executor: the collapsed-stack view of
+        # what each process's CPUs were doing just before the failure
+        profiles = self.profiles.last_windows()
+        if profiles:
+            doc["profiles"] = profiles
         if breakdown is not None:
             doc["breakdown"] = breakdown
         if error is not None:

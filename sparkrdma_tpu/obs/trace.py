@@ -47,6 +47,32 @@ _current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar
     "sparkrdma_tpu_obs_span", default=None
 )
 
+# Thread-ident → innermost OPEN span, maintained by ``Tracer.span()``
+# only while a watcher (the sampling profiler, obs/profiler.py) has
+# asked for it via ``set_span_watch(True)``: a contextvar can't be read
+# cross-thread, and the profiler's timer thread must tag each sampled
+# thread with its active span. Plain dict ops are atomic under the GIL;
+# the gate keeps the disabled cost at one module-global load per span.
+_span_watch = False
+_active_by_ident: Dict[int, "Span"] = {}
+
+
+def set_span_watch(enabled: bool) -> None:
+    """Turn the thread-ident → active-span side table on/off (profiler
+    lifecycle hook). Turning it off clears the table."""
+    global _span_watch
+    _span_watch = bool(enabled)
+    if not enabled:
+        _active_by_ident.clear()
+
+
+def active_span_of_ident(ident: int) -> "Optional[Span]":
+    """Innermost open span on thread ``ident`` — readable from any
+    thread, None when the thread has no open span (or the watch is
+    off). Spans opened before the watch was enabled are not visible."""
+    return _active_by_ident.get(ident)
+
+
 _span_ids = itertools.count(1)
 _tracers_lock = threading.Lock()
 _tracers: "List[Tracer]" = []
@@ -212,10 +238,17 @@ class Tracer:
                   now(), args)
         _link(sp, follows)
         token = _current_span.set(sp)
+        if _span_watch:
+            _active_by_ident[sp.tid] = sp
         try:
             yield sp
         finally:
             _current_span.reset(token)
+            if _span_watch:
+                if parent is not None:
+                    _active_by_ident[sp.tid] = parent
+                else:
+                    _active_by_ident.pop(sp.tid, None)
             sp.end = now()
             if not sp.trace_id:
                 sp.trace_id = self._resolve_trace(trace_id, shuffle_id, parent)

@@ -33,14 +33,38 @@ DEFAULT_TENANT = "default"
 
 _tls = threading.local()
 
+# Thread-ident → tenant side table for cross-thread readers (the
+# sampling profiler, obs/profiler.py, reads OTHER threads' tenants
+# from its timer thread — a threading.local can't serve that). Plain
+# dict ops are atomic under the GIL; entries for the default tenant
+# are dropped so idle/finished threads don't accumulate.
+_tenant_by_ident: Dict[int, str] = {}
+
+
+def _publish_ident(tenant: str) -> None:
+    ident = threading.get_ident()
+    if tenant == DEFAULT_TENANT:
+        _tenant_by_ident.pop(ident, None)
+    else:
+        _tenant_by_ident[ident] = tenant
+
 
 def current_tenant() -> str:
     """The tenant id owning the current thread's work."""
     return getattr(_tls, "tenant", DEFAULT_TENANT)
 
 
+def tenant_of_ident(ident: int) -> str:
+    """Tenant owning thread ``ident``'s work right now — readable from
+    ANY thread (unlike :func:`current_tenant`). Used by the sampling
+    profiler to tag wall-clock samples."""
+    return _tenant_by_ident.get(ident, DEFAULT_TENANT)
+
+
 def set_current_tenant(tenant: Optional[str]) -> None:
-    _tls.tenant = tenant or DEFAULT_TENANT
+    t = tenant or DEFAULT_TENANT
+    _tls.tenant = t
+    _publish_ident(t)
 
 
 @contextlib.contextmanager
@@ -50,10 +74,12 @@ def tenant_scope(tenant: Optional[str]) -> Iterator[str]:
     prev = getattr(_tls, "tenant", DEFAULT_TENANT)
     t = tenant or DEFAULT_TENANT
     _tls.tenant = t
+    _publish_ident(t)
     try:
         yield t
     finally:
         _tls.tenant = prev
+        _publish_ident(prev)
 
 
 def scoped(tenant: Optional[str], fn):
@@ -96,6 +122,7 @@ from sparkrdma_tpu.tenancy.quota import QuotaBroker  # noqa: E402
 __all__ = [
     "DEFAULT_TENANT",
     "current_tenant",
+    "tenant_of_ident",
     "set_current_tenant",
     "tenant_scope",
     "scoped",

@@ -76,6 +76,11 @@ DECLARED_KNOBS: Dict[str, str] = {
     "obs.telemetry.flightWindows": "ring windows per flight record",
     "obs.telemetry.flightDir": "flight-record output directory",
     "obs.telemetry.openmetricsFile": "periodic OpenMetrics file egress",
+    "obs.profile.enabled": "always-on wall-clock sampling profiler",
+    "obs.profile.hz": "profiler sampling rate (samples/s per thread)",
+    "obs.profile.maxFrames": "deepest stack recorded per sample",
+    "obs.profile.windowMs": "recent-sample window (flight records, "
+                            "gap-frame annotation)",
     "driverHost": "driver RPC host",
     "driverPort": "driver RPC port (0 = ephemeral, written back)",
     "executorPort": "executor listener port (0 = ephemeral)",
@@ -369,6 +374,30 @@ class TpuShuffleConf:
         """If set, the hub rewrites this file with the OpenMetrics
         exposition once per interval (scrape-less egress)."""
         return str(self.get(PREFIX + "obs.telemetry.openmetricsFile", "") or "")
+
+    # -- continuous profiling plane (obs/profiler.py) ---------------------
+    @property
+    def profile_enabled(self) -> bool:
+        """Wall-clock sampling profiler (one timer thread per process)."""
+        return self._bool("obs.profile.enabled", True)
+
+    @property
+    def profile_hz(self) -> int:
+        """Sampling rate. 19 Hz default: high enough to attribute
+        ≥100 ms gaps, low enough for the ≤2% overhead gate, and prime
+        so it can't phase-lock with periodic workload timers."""
+        return self._int("obs.profile.hz", 19, 1, 997)
+
+    @property
+    def profile_max_frames(self) -> int:
+        """Deepest stack recorded per sample (leaf-most frames kept)."""
+        return self._int("obs.profile.maxFrames", 48, 4, 512)
+
+    @property
+    def profile_window_ms(self) -> int:
+        """Trailing window served to flight records and critical-path
+        gap-frame annotation."""
+        return self._int("obs.profile.windowMs", 2000, 100, 600000)
 
     # -- endpoints / connection management (RdmaShuffleConf.scala:118-126)
     @property

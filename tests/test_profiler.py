@@ -1,0 +1,227 @@
+"""Continuous profiling plane (obs/profiler.py): hot-thread attribution
+with tenant + span-category tags, heartbeat round-trip into the
+driver-side merged ProfileHub, critical-path gap annotation, the
+config off-switch, and the flamegraph CLI — ISSUE 15's acceptance
+tests (docs/OBSERVABILITY.md "Continuous profiling")."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from sparkrdma_tpu import tenancy
+from sparkrdma_tpu.obs import (
+    Heartbeater,
+    MetricsRegistry,
+    ProfileHub,
+    SamplingProfiler,
+    TelemetryHub,
+    get_tracer,
+    render_flamegraph_html,
+)
+from sparkrdma_tpu.obs.attr import classify
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+def _hot_thread(stop: threading.Event, ready: threading.Event) -> None:
+    """Busy loop under a named tenant inside a shuffle-fetch span — the
+    sampler must attribute its stacks with BOTH tags."""
+    with tenancy.tenant_scope("alice"):
+        with get_tracer().span("shuffle.fetch.hot"):
+            ready.set()
+            while not stop.is_set():
+                sum(i * i for i in range(200))
+
+
+def _run_hot(profiler: SamplingProfiler, seconds: float = 0.2):
+    stop, ready = threading.Event(), threading.Event()
+    t = threading.Thread(target=_hot_thread, args=(stop, ready), daemon=True)
+    profiler.start()
+    try:
+        t.start()
+        assert ready.wait(5.0)
+        time.sleep(seconds)
+    finally:
+        stop.set()
+        t.join(5.0)
+        profiler.stop()
+
+
+# ---------------------------------------------------------------------------
+# sampler attribution
+# ---------------------------------------------------------------------------
+
+def test_sampler_tags_hot_thread_with_tenant_and_span_category():
+    reg = MetricsRegistry()
+    p = SamplingProfiler(reg, role="t0", hz=200)
+    _run_hot(p)
+    profile = p.drain()
+    assert profile is not None and profile["hz"] == 200
+    want_cat = classify("shuffle.fetch.hot")
+    hot = [r for r in profile["rows"]
+           if r[0] == "alice" and r[1] == want_cat and "_hot_thread" in r[2]]
+    assert hot, f"no tagged hot-thread rows in {profile['rows'][:5]}"
+    # stacks are root-first collapsed frames: module:func;module:func
+    assert ";" in hot[0][2] and ":" in hot[0][2]
+    snap = reg.snapshot(prefix="profile.")
+    assert snap["counters"].get("profile.samples{role=t0}", 0) > 0
+    assert not p.running  # stop() joined the timer thread
+
+
+def test_off_profiler_leaves_no_span_watch_cost():
+    # with no sampler running, span bookkeeping must not accumulate
+    from sparkrdma_tpu.obs import trace as _trace
+
+    with get_tracer().span("shuffle.write.idle"):
+        assert _trace.active_span_of_ident(threading.get_ident()) is None
+
+
+# ---------------------------------------------------------------------------
+# heartbeat round-trip into the merged hub
+# ---------------------------------------------------------------------------
+
+def test_profile_rows_ride_heartbeat_into_cluster_hub():
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", interval_ms=50)
+    p = SamplingProfiler(reg, role="e7", hz=200)
+    hb = Heartbeater(reg, "e7", interval_ms=50, send=hub.ingest, profiler=p)
+    _run_hot(p)
+    hb.beat()
+    hub.stop()
+    assert hub.profiles.total_samples > 0
+    assert "e7" in hub.profiles.executors()
+    want_cat = classify("shuffle.fetch.hot")
+    merged = hub.profiles.merged_rows()
+    assert any(e == "e7" and t == "alice" and c == want_cat
+               for e, t, c, _s, _n in merged)
+    # the per-category self-time view is what critpath cross-checks
+    assert hub.profiles.category_self_ms().get(want_cat, 0) > 0
+    # post-mortems carry the last profile window per executor
+    windows = hub.profiles.last_windows()
+    assert "e7" in windows and windows["e7"]["rows"]
+
+
+def test_flight_record_doc_attaches_profiles(tmp_path):
+    import json
+
+    reg = MetricsRegistry()
+    hub = TelemetryHub(role="drv", interval_ms=50)
+    p = SamplingProfiler(reg, role="e9", hz=200)
+    hb = Heartbeater(reg, "e9", interval_ms=50, send=hub.ingest, profiler=p)
+    _run_hot(p, seconds=0.1)
+    hb.beat()
+    out = tmp_path / "flight.json"
+    hub.flight_record("profiler-test", path=str(out))
+    hub.stop()
+    doc = json.loads(out.read_text())
+    assert "profiles" in doc and "e9" in doc["profiles"]
+    assert doc["profiles"]["e9"]["rows"]
+
+
+# ---------------------------------------------------------------------------
+# critical-path gap annotation
+# ---------------------------------------------------------------------------
+
+def _burn_gap(seconds: float) -> int:
+    # no genexpr/helper in the loop body: samples must land with THIS
+    # function as the leaf frame so the gap annotation can name it
+    t0 = time.perf_counter()
+    x = 1
+    while time.perf_counter() - t0 < seconds:
+        x = (x * 1103515245 + 12345) % (1 << 31)
+    return x
+
+
+def test_gap_segments_name_the_sampled_busy_frame():
+    from sparkrdma_tpu.obs.critpath import job_breakdown
+    from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
+
+    conf = TpuShuffleConf({"tpu.shuffle.obs.profile.hz": "199"})
+    p = acquire_profiler(conf, role="gap-test")
+    assert p is not None and p.running
+    tracer = get_tracer()
+    try:
+        with tracer.span("job.run", job="gap-test") as job:
+            with tracer.span("shuffle.write.seed"):
+                time.sleep(0.02)
+            _burn_gap(0.3)  # unspanned busy work = critical-path gap
+        verdict = job_breakdown(job)
+    finally:
+        release_profiler(p)
+    assert verdict.gap_frames, "no gap frames annotated"
+    assert any("_burn_gap" in frame for frame in verdict.gap_frames), (
+        f"busy frame not named in {sorted(verdict.gap_frames)[:5]}"
+    )
+    # the rendered report surfaces the dominant gap frames
+    assert "gap frames" in verdict.render()
+
+
+# ---------------------------------------------------------------------------
+# off-switch & engine wiring
+# ---------------------------------------------------------------------------
+
+def test_off_switch_spawns_no_sampler_threads():
+    from sparkrdma_tpu.engine.context import TpuContext
+
+    conf = TpuShuffleConf({"tpu.shuffle.obs.profile.enabled": "false"})
+    with TpuContext(num_executors=1, conf=conf, task_threads=1) as ctx:
+        assert ctx.profiler is None
+        assert not any(t.name == "sparkrdma-profiler" and t.is_alive()
+                       for t in threading.enumerate())
+    assert not any(t.name == "sparkrdma-profiler" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_context_profiler_is_refcounted_singleton_and_released():
+    from sparkrdma_tpu.engine.context import TpuContext
+    from sparkrdma_tpu.obs.profiler import get_profiler
+
+    with TpuContext(num_executors=1, task_threads=1) as ctx:
+        assert ctx.profiler is not None and ctx.profiler.running
+        assert get_profiler() is ctx.profiler  # process-wide singleton
+    # context stop released the last ref: the timer thread is gone
+    time.sleep(0.05)
+    assert not any(t.name == "sparkrdma-profiler" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# hub merge + flamegraph rendering
+# ---------------------------------------------------------------------------
+
+def test_hub_merges_rows_and_renders_tagged_flamegraph():
+    hub = ProfileHub()
+    hub.ingest("e0", {"hz": 100, "rows": [
+        ["alice", "host-read", "m:a;m:b", 30],
+        ["bob", "device", "m:a;m:c", 10],
+    ]})
+    hub.ingest("e1", {"hz": 100, "rows": [["alice", "host-read", "m:a;m:b", 5]]})
+    assert hub.total_samples == 45
+    assert hub.executors() == ["e0", "e1"]
+    folded = hub.folded()
+    assert "tenant:alice" in folded and "span:host-read" in folded
+    html = hub.flamegraph_html(title="t")
+    assert "tenant:alice" in html and "<html" in html.lower()
+    # the standalone renderer takes (frames_root_first, count) pairs
+    html2 = render_flamegraph_html([(["a", "b"], 3), (["a", "c"], 1)],
+                                   title="x")
+    assert "</html>" in html2
+
+
+def test_cli_demo_writes_folded_and_flamegraph(tmp_path):
+    html = tmp_path / "flame.html"
+    folded = tmp_path / "stacks.folded"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "sparkrdma_tpu.obs", "--demo",
+         "--flamegraph", str(html), "--folded", str(folded)],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = folded.read_text()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert lines and all(ln.rsplit(" ", 1)[1].isdigit() for ln in lines)
+    assert "tenant:" in text and "span:" in text
+    page = html.read_text()
+    assert "</html>" in page and "tenant:" in page
