@@ -1396,6 +1396,135 @@ def bench_profiler_overhead_ab(dry_run: bool = False) -> dict:
     return out
 
 
+def bench_slo_overhead_ab(dry_run: bool = False) -> dict:
+    """Interleaved SLO-evaluator-off vs -on A/B on the SAME warm context
+    (obs/slo.py, docs/OBSERVABILITY.md "SLOs & automated diagnosis").
+
+    Both sides run the same sequential job set on one TpuContext whose
+    driver hub evaluates every 100 ms with a latency objective installed
+    (a deliberately unreachable p99 bar, so no breach/diagnosis path
+    fires — this measures the steady-state cost of burn-rate evaluation
+    itself); the "off" side flips ``hub.slo.enabled`` so heartbeats skip
+    evaluation entirely. The acceptance budget is ≤2%, evaluated only
+    when the interleaved pairs are stable enough to resolve it (pair
+    spread ≤ 4%); otherwise it SKIPS LOUDLY with ``gate_skip_reason``,
+    never a silent pass."""
+    from sparkrdma_tpu.engine.context import TpuContext
+    from sparkrdma_tpu.obs import get_registry
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    n_jobs = 2
+    n_rows = 2_000 if dry_run else 20_000
+    n_parts = 4
+    n_pairs = 2 if dry_run else 5
+    reg = get_registry()
+    eval_interval_ms = 100
+    conf = TpuShuffleConf({
+        "tpu.shuffle.obs.profile.enabled": "false",
+        "tpu.shuffle.obs.telemetry.intervalMs": "100",
+        "tpu.shuffle.obs.slo.evalIntervalMs": str(eval_interval_ms),
+        # install the latency objective but keep it unbreachable: the
+        # A/B measures evaluation cost, not breach handling
+        "tpu.shuffle.obs.slo.taskP99Ms": "600000",
+    })
+
+    def evaluations():
+        snap = reg.snapshot(prefix="slo.evaluations")
+        return sum(snap.get("counters", {}).values())
+
+    with TpuContext(num_executors=2, conf=conf, task_threads=2) as ctx:
+        hub = ctx.driver.telemetry
+        if hub is None:
+            raise SystemExit("BENCH FAILED: slo A/B needs driver telemetry")
+
+        def run_jobs():
+            for j in range(n_jobs):
+                mod = 4093 + j
+                rdd = (
+                    ctx.parallelize(range(n_rows), n_parts)
+                    .map(lambda x, m=mod: (x % m, x))
+                    .reduce_by_key(lambda a, b: a + b,
+                                   num_partitions=n_parts)
+                )
+                if not ctx.run_job(rdd):
+                    raise SystemExit(
+                        "BENCH FAILED: slo A/B job returned nothing"
+                    )
+
+        def bytes_written():
+            snap = reg.snapshot(prefix="writer.bytes_written")
+            return sum(snap.get("counters", {}).values())
+
+        def one_side(enabled):
+            hub.slo.enabled = enabled
+            b0 = bytes_written()
+            t0 = time.perf_counter()
+            try:
+                run_jobs()
+            finally:
+                hub.slo.enabled = True
+            return (bytes_written() - b0) / (time.perf_counter() - t0) / 1e6
+
+        run_jobs()  # warm: executors, pools, codecs
+        e0 = evaluations()
+        pairs = []
+        for _ in range(n_pairs):
+            a = one_side(False)
+            b = one_side(True)
+            pairs.append({"off_mbps": round(a, 3), "on_mbps": round(b, 3)})
+        evals = int(evaluations() - e0)
+        breaches = len(hub.slo.breaches)
+    med_a = float(np.median([p["off_mbps"] for p in pairs]))
+    med_b = float(np.median([p["on_mbps"] for p in pairs]))
+    overhead_pct = round((1.0 - med_b / med_a) * 100.0, 3) if med_a else None
+    ratios = [p["on_mbps"] / p["off_mbps"] for p in pairs if p["off_mbps"]]
+    pair_spread_pct = (
+        round((max(ratios) - min(ratios)) * 100.0, 3) if ratios else None
+    )
+    gate_evaluated = (
+        not dry_run
+        and overhead_pct is not None
+        and pair_spread_pct is not None
+        and pair_spread_pct <= 4.0
+        and evals > 0
+    )
+    gate_skip_reason = None
+    if not gate_evaluated:
+        if dry_run:
+            gate_skip_reason = (
+                "dry run: volume too small to resolve a 2% delta"
+            )
+        elif evals == 0:
+            gate_skip_reason = "SLO engine recorded zero evaluations"
+        elif pair_spread_pct is None or overhead_pct is None:
+            gate_skip_reason = "no throughput measured"
+        else:
+            gate_skip_reason = (
+                f"pair spread {pair_spread_pct}% > 4%: run too noisy to "
+                "resolve a 2% overhead budget"
+            )
+    if gate_evaluated and overhead_pct > 2.0:
+        raise SystemExit(
+            f"BENCH FAILED: SLO evaluator overhead {overhead_pct}% > 2% at "
+            f"{eval_interval_ms} ms cadence (off {med_a:.1f} MB/s, "
+            f"on {med_b:.1f} MB/s)"
+        )
+    return {
+        "ab_slo_overhead": {
+            "pairs": pairs,
+            "off_mbps": round(med_a, 3),
+            "on_mbps": round(med_b, 3),
+            "overhead_pct": overhead_pct,
+            "pair_spread_pct": pair_spread_pct,
+            "eval_interval_ms": eval_interval_ms,
+            "slo_evaluations": evals,
+            "slo_breaches": breaches,
+            "gate_evaluated": gate_evaluated,
+            "gate_skip_reason": gate_skip_reason,
+        }
+    }
+
+
 def _is_tpu() -> bool:
     try:
         from sparkrdma_tpu.ops.remote_copy import is_tpu_mesh
@@ -1714,7 +1843,7 @@ def main() -> None:
         "--ab",
         default="",
         choices=["", "device_fetch", "concurrent_jobs", "iouring_read",
-                 "consume_sharded", "profiler_overhead"],
+                 "consume_sharded", "profiler_overhead", "slo_overhead"],
         help="run ONE A/B at reduced volume and print its JSON — the CI "
         "obs smoke's dry-run mode (e.g. --ab device_fetch)",
     )
@@ -1725,6 +1854,7 @@ def main() -> None:
         "iouring_read": bench_iouring_read_ab,
         "consume_sharded": bench_consume_sharded_ab,
         "profiler_overhead": bench_profiler_overhead_ab,
+        "slo_overhead": bench_slo_overhead_ab,
     }
     if args.ab:
         record = dry_abs[args.ab](dry_run=True)
@@ -1761,6 +1891,7 @@ def main() -> None:
     out.update(bench_device_fetch_ab())
     out.update(bench_concurrent_jobs_ab())
     out.update(bench_profiler_overhead_ab())
+    out.update(bench_slo_overhead_ab())
     import jax
 
     out.update(bench_device(jax))

@@ -26,6 +26,17 @@ obs registry for the serving invariants:
   moves on the data plane and NEVER shows up as an ``rpc.handle_ms``
   message type (recorded either way).
 
+Since PR 16 the verdicts are built on the SLO engine's shared
+:func:`~sparkrdma_tpu.obs.slo.judge` primitive (soak and production
+share one evaluator), the driver hub's live SLO/burn-rate state rides
+the ledger as ``ledger["slo"]`` (breach + diagnosis records included,
+rendered by ``python -m sparkrdma_tpu.obs --diagnose LEDGER``), and a
+chaos mode exists: ``--fault-plan`` installs a seeded
+``testing/faults.py`` plan for the soak segment and ``--expect-breach``
+flips the gate — the run fails unless an SLO breach fired AND the
+automated diagnosis names the injected seam. Without a fault plan the
+gate is the opposite: zero breaches, zero diagnoses (no false pages).
+
 Emits one JSON ledger (``--out``, default SOAK_r01.json) and exits
 nonzero when a required check fails. CI smoke:
 ``python benchmarks/soak.py --seconds 20 --tenants 3`` — fails on HWM
@@ -48,6 +59,7 @@ import numpy as np
 
 from sparkrdma_tpu.engine.context import TpuContext
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.slo import judge
 from sparkrdma_tpu.tenancy import quota as _quota
 from sparkrdma_tpu.utils.config import TpuShuffleConf
 
@@ -172,17 +184,27 @@ def _hwm(snap, name) -> int:
 def run_soak(args) -> dict:
     tenants = [f"tenant-{i}" for i in range(args.tenants)]
     weights = {t: WEIGHTS[i] for i, t in enumerate(tenants)}
-    conf = TpuShuffleConf(
-        {
-            "tpu.shuffle.tenancy.weights": ",".join(
-                f"{t}:{w}" for t, w in weights.items()
-            ),
-            # mapped (zero-copy page-cache) delivery bypasses the pooled
-            # destination buffers entirely, which would make the mempool
-            # HWM-flatness check vacuous — soak the pooled plane instead
-            "tpu.shuffle.mappedFetch": "false",
-        }
-    )
+    conf_map = {
+        "tpu.shuffle.tenancy.weights": ",".join(
+            f"{t}:{w}" for t, w in weights.items()
+        ),
+        # mapped (zero-copy page-cache) delivery bypasses the pooled
+        # destination buffers entirely, which would make the mempool
+        # HWM-flatness check vacuous — soak the pooled plane instead
+        "tpu.shuffle.mappedFetch": "false",
+    }
+    if args.fault_plan:
+        # chaos mode: seeded fault plan travels the normal conf path
+        # (manager ensure_installed), exactly like production would
+        conf_map["tpu.shuffle.faultPlan"] = args.fault_plan
+        conf_map["tpu.shuffle.faultPlanSeed"] = str(args.fault_seed)
+    if args.slo_task_p99_ms:
+        conf_map["tpu.shuffle.obs.slo.taskP99Ms"] = str(args.slo_task_p99_ms)
+        # tighten the telemetry/eval cadence so a short soak still
+        # accumulates enough ring windows for the burn-rate horizons
+        conf_map["tpu.shuffle.obs.telemetry.intervalMs"] = "250"
+        conf_map["tpu.shuffle.obs.slo.evalIntervalMs"] = "500"
+    conf = TpuShuffleConf(conf_map)
     reg = get_registry()
     stats = {
         t: {"jobs": 0, "jobs_2nd_half": 0, "failures": [], "by_shape": {}}
@@ -236,6 +258,15 @@ def run_soak(args) -> dict:
         for t in threads:
             t.join(timeout=args.seconds + 120)
         snap_end = reg.snapshot()
+        # drain the tail into the hub and force one final SLO pass, so
+        # short runs can't end between evaluation cadences
+        ctx.telemetry_flush()
+        hub = ctx.driver.telemetry
+        if hub is not None:
+            hub.slo.evaluate()
+            slo_summary = hub.slo.summary()
+        else:
+            slo_summary = {}
 
     # ---- per-tenant ledger -------------------------------------------
     total_secs = 0.0
@@ -283,6 +314,7 @@ def run_soak(args) -> dict:
             for k, v in snap_end["counters"].items()
             if k.startswith("admission.")
         },
+        "slo": slo_summary,
     }
 
 
@@ -461,6 +493,25 @@ def main() -> int:
         action="store_true",
         help="skip the subprocess push-vs-rpc segment",
     )
+    ap.add_argument(
+        "--fault-plan", default="",
+        help="chaos mode: install this seeded fault plan "
+        "(testing/faults.py grammar) for the soak segment; the quota "
+        "and push probes are skipped so the injected faults cannot "
+        "leak into their baselines",
+    )
+    ap.add_argument("--fault-seed", type=int, default=1)
+    ap.add_argument(
+        "--expect-breach", action="store_true",
+        help="with --fault-plan: gate on an SLO breach firing AND the "
+        "automated diagnosis naming the injected seam (instead of the "
+        "default zero-breach gate)",
+    )
+    ap.add_argument(
+        "--slo-task-p99-ms", type=int, default=0,
+        help="install the p99 task-latency objective at this target "
+        "(tpu.shuffle.obs.slo.taskP99Ms) for the soak segment",
+    )
     args = ap.parse_args()
 
     ledger = {
@@ -470,11 +521,17 @@ def main() -> int:
             "scale": args.scale,
             "seed": args.seed,
             "strict": args.strict,
+            "fault_plan": [args.fault_plan],
+            "expect_breach": args.expect_breach,
+            "slo_task_p99_ms": args.slo_task_p99_ms,
         },
     }
     ledger["soak"] = run_soak(args)
-    ledger["quota_probe"] = run_quota_probe(args)
-    if not args.skip_cluster_probe:
+    ledger["slo"] = ledger["soak"].pop("slo", {})
+    chaos_mode = bool(args.fault_plan)
+    if not chaos_mode:
+        ledger["quota_probe"] = run_quota_probe(args)
+    if not args.skip_cluster_probe and not chaos_mode:
         try:
             ledger["push_rpc_probe"] = run_push_rpc_probe(args)
         except Exception as e:  # noqa: BLE001 — recorded, CI-gated below
@@ -482,35 +539,87 @@ def main() -> int:
                 "error": f"{type(e).__name__}: {e}"
             }
 
-    # ---- verdicts ----------------------------------------------------
+    # ---- verdicts: every bar is one slo.judge() record ----------------
+    verdicts = []
     checks = {}
+
+    def check(key, verdict):
+        verdicts.append(verdict)
+        checks[key] = verdict["ok"]
+
     soak = ledger["soak"]
-    checks["zero_job_failures"] = all(
-        not v["failures"] for v in soak["per_tenant"].values()
-    )
-    checks["no_starved_tenant"] = all(
-        v["jobs_2nd_half"] >= 1 for v in soak["per_tenant"].values()
-    )
-    checks["hwm_flat"] = all(
-        h["growth_pct"] <= 10.0 for h in soak["hwm"].values()
-    )
-    checks["quota_backpressure_engaged"] = (
-        ledger["quota_probe"]["hog_quota_blocks"] >= 1
-        and ledger["quota_probe"]["hog_jobs_completed"] >= 1
-    )
+    check("zero_job_failures", judge(
+        "zero-job-failures",
+        sum(len(v["failures"]) for v in soak["per_tenant"].values()),
+        0, "eq"))
+    check("no_starved_tenant", judge(
+        "no-starved-tenant",
+        min(v["jobs_2nd_half"] for v in soak["per_tenant"].values()),
+        1, "ge"))
+    check("hwm_flat", judge(
+        "hwm-flat",
+        max(h["growth_pct"] for h in soak["hwm"].values()),
+        10.0, "le", note="steady-state HWM growth pct, 2nd half"))
+    # per-tenant p99 from the same exceedance identity the online
+    # latency objective enforces — recorded always, never a gate here
+    # (chaos mode exists to violate it; the gate is the breach check)
+    if args.slo_task_p99_ms:
+        for t, row in sorted(soak["per_tenant"].items()):
+            verdicts.append(judge(
+                f"task-p99-{t}", row["p99_task_ms_2nd_half"],
+                args.slo_task_p99_ms,
+                "le", note="recorded only; gated online via burn rate"))
+    if "quota_probe" in ledger:
+        check("quota_backpressure_engaged", judge(
+            "quota-backpressure-engaged",
+            min(ledger["quota_probe"]["hog_quota_blocks"],
+                ledger["quota_probe"]["hog_jobs_completed"]),
+            1, "ge",
+            note="hog must both block on quota and keep progressing"))
     probe = ledger.get("push_rpc_probe", {})
     if "error" not in probe and probe:
-        checks["push_absent_from_rpc_handle_ms"] = (
-            not probe["push_in_rpc_handle_ms"] and probe["pushed_bytes"] > 0
-        )
+        check("push_absent_from_rpc_handle_ms", judge(
+            "push-absent-from-rpc-handle-ms",
+            int(not probe["push_in_rpc_handle_ms"]
+                and probe["pushed_bytes"] > 0),
+            1, "eq"))
+    # ---- SLO-engine gates: breaches answer to the fault plan ----------
+    breach_count = int(ledger["slo"].get("breach_count", 0))
+    diagnoses = ledger["slo"].get("diagnosis_records", [])
+    if chaos_mode and args.expect_breach:
+        check("slo_breach_observed", judge(
+            "slo-breach-observed", breach_count, 1, "ge",
+            note="seeded fault plan must trip the latency objective"))
+        want_peer = ""
+        for part in args.fault_plan.replace(":", ",").split(","):
+            if part.startswith("peer="):
+                want_peer = part[len("peer="):]
+        named = 0
+        for diag in diagnoses:
+            top = diag.get("top_cause") or {}
+            if (top.get("cause") == "injected-fault"
+                    and (not want_peer or top.get("executor") == want_peer)
+                    and top.get("category")):
+                named = 1
+        check("diagnosis_names_injected_seam", judge(
+            "diagnosis-names-injected-seam", named, 1, "eq",
+            note=f"top cause must be the injected fault on "
+                 f"{want_peer or 'any executor'} with a stage category"))
+    elif not chaos_mode:
+        check("zero_slo_breaches", judge(
+            "zero-slo-breaches", breach_count, 0, "eq",
+            note="healthy soak must not page"))
+        check("zero_diagnoses", judge(
+            "zero-diagnoses", len(diagnoses), 0, "eq"))
     if args.strict:
-        checks["fairness_within_25pct"] = soak["fairness_max_rel_dev"] <= 0.25
+        check("fairness_within_25pct", judge(
+            "fairness-within-25pct", soak["fairness_max_rel_dev"],
+            0.25, "le"))
         slowdown = ledger["quota_probe"]["quiet_slowdown"]
         cores = os.cpu_count() or 1
         if cores >= 4:
-            checks["quiet_within_10pct_of_solo"] = (
-                slowdown is not None and slowdown <= 1.10
-            )
+            check("quiet_within_10pct_of_solo", judge(
+                "quiet-within-10pct-of-solo", slowdown, 1.10, "le"))
         else:
             # on a rig with fewer cores than the two concurrent
             # workloads need, the quiet tenant pays raw CPU contention
@@ -521,6 +630,7 @@ def main() -> int:
                 " < 4, quiet tenant's slowdown is CPU contention, not"
                 " quota spillover"
             )
+    ledger["slo"]["verdicts"] = verdicts
     ledger["checks"] = checks
     ledger["ok"] = all(checks.values())
 

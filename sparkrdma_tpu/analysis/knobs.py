@@ -6,7 +6,7 @@ Candidates are collected from three shapes:
   example configs),
 - ``PREFIX + "suffix"`` concatenations (the idiom inside
   ``utils/config.py`` raw reads and the quota per-tenant scan),
-- the first argument of ``self._int/_bytes/_bool`` calls inside
+- the first argument of ``self._int/_float/_bytes/_bool`` calls inside
   ``utils/config.py`` (the clamped typed getters take bare suffixes).
 
 Each candidate must resolve against ``DECLARED_KNOBS`` /
@@ -28,7 +28,7 @@ from sparkrdma_tpu.analysis import Finding, SourceFile
 
 PASS_ID = "knob-registry"
 
-_GETTERS = {"_int", "_bytes", "_bool"}
+_GETTERS = {"_int", "_float", "_bytes", "_bool"}
 
 
 def _pattern_regexes() -> List[re.Pattern]:

@@ -213,6 +213,10 @@ class ClusterContext:
             from sparkrdma_tpu.obs.critpath import job_breakdown
 
             self.last_breakdown = job_breakdown(job_span, role="driver")
+            if self.driver.telemetry is not None:
+                self.driver.telemetry.note_breakdown(
+                    self.last_breakdown.to_dict()
+                )
             return self.last_breakdown
         except Exception:
             logger.exception("critical-path attribution failed")

@@ -352,6 +352,12 @@ class TpuContext:
             from sparkrdma_tpu.obs.critpath import job_breakdown
 
             self.last_breakdown = job_breakdown(job_span, role="driver")
+            if self.driver.telemetry is not None:
+                # diagnosis evidence: the SLO engine's root-cause pass
+                # reads the dominant category from the hub
+                self.driver.telemetry.note_breakdown(
+                    self.last_breakdown.to_dict()
+                )
             return self.last_breakdown
         except Exception:
             logger.exception("critical-path attribution failed")
@@ -373,6 +379,8 @@ class TpuContext:
         snap["registry"] = get_registry().snapshot()
         if self.last_breakdown is not None:
             snap["breakdown"] = self.last_breakdown.to_dict()
+        if self.driver.telemetry is not None:
+            snap["slo"] = self.driver.telemetry.slo.summary()
         return snap
 
     def telemetry_flush(self) -> None:

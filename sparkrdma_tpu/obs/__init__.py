@@ -32,6 +32,16 @@ from sparkrdma_tpu.obs.profiler import (
     release_profiler,
     render_flamegraph_html,
 )
+from sparkrdma_tpu.obs.diagnose import build_diagnosis, render_diagnosis
+from sparkrdma_tpu.obs.slo import (
+    Breach,
+    Objective,
+    SLOEngine,
+    burn_rate,
+    exceedance,
+    judge,
+    multi_window_burn,
+)
 from sparkrdma_tpu.obs.telemetry import Heartbeater, TelemetryHub
 from sparkrdma_tpu.obs.timeseries import TimeSeriesRing, Window
 from sparkrdma_tpu.obs.trace import (
@@ -49,12 +59,15 @@ from sparkrdma_tpu.obs.trace import (
 )
 
 __all__ = [
+    "Breach",
     "Counter",
     "Gauge",
     "Heartbeater",
     "Histogram",
     "MetricsRegistry",
+    "Objective",
     "OpenMetricsServer",
+    "SLOEngine",
     "ProfileHub",
     "SamplingProfiler",
     "Span",
@@ -65,18 +78,24 @@ __all__ = [
     "Window",
     "acquire_profiler",
     "all_tracers",
+    "build_diagnosis",
+    "burn_rate",
     "collect_spans",
     "collect_spans_with_epochs",
+    "exceedance",
     "export_chrome_trace",
     "extract_snapshot",
     "get_profiler",
     "get_registry",
     "get_tracer",
+    "judge",
     "metric_key",
     "mint_trace_id",
+    "multi_window_burn",
     "now",
     "parse_metric_key",
     "release_profiler",
+    "render_diagnosis",
     "render_flamegraph_html",
     "render_openmetrics",
     "snapshot_delta",

@@ -16,6 +16,9 @@ from a registry snapshot saved inside a bench/workload artifact JSON.
 (obs/telemetry.py) and exits. ``--critical-path FILE`` replays the
 critical-path attribution (obs/critpath.py) over a saved Chrome trace,
 or prints the ``breakdown`` stored in a bench/flight artifact.
+``--diagnose FILE`` renders SLO breach diagnoses (obs/diagnose.py)
+from a standalone diagnosis artifact, a flight record's ``slo``
+section, or a soak ledger's ``slo.diagnosis_records``.
 
 Continuous profiling (obs/profiler.py): ``--demo`` runs under the
 default sampling profiler, and ``--flamegraph [DEST]`` /
@@ -134,6 +137,40 @@ def _print_flight(path: str) -> int:
     return 0
 
 
+def _print_diagnosis(path: str) -> int:
+    """Render every diagnosis artifact reachable from ``path``: a
+    standalone ``sparkrdma_diagnosis`` JSON, a flight record (its
+    ``slo`` section), or a soak/bench ledger (``["slo"]``)."""
+    from sparkrdma_tpu.obs.diagnose import render
+
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        print(f"{path}: not a JSON object", file=sys.stderr)
+        return 2
+    if doc.get("kind") == "sparkrdma_diagnosis":
+        print(render(doc))
+        return 0
+    slo = doc.get("slo") or {}
+    breaches = slo.get("breach_records") or []
+    diagnoses = slo.get("diagnosis_records") or []
+    if not breaches and not diagnoses and "slo" not in doc:
+        print(f"{path}: no 'slo' section and not a diagnosis artifact "
+              "(kind=sparkrdma_diagnosis)", file=sys.stderr)
+        return 2
+    print(f"{path}: {slo.get('objectives', 0)} objectives, "
+          f"{slo.get('breach_count', len(breaches))} breaches, "
+          f"{len(diagnoses)} diagnoses")
+    for b in breaches:
+        where = f" executor={b['executor']}" if b.get("executor") else ""
+        print(f"  breach: {b.get('objective')} [{b.get('severity')}]"
+              f"{where} at wall {b.get('wall_ms')} ms")
+    for diag in diagnoses:
+        print()
+        print(render(diag))
+    return 0
+
+
 def _hub_from_flight(doc: dict) -> ProfileHub:
     """Rebuild a ProfileHub from a flight record's profile windows."""
     hub = ProfileHub()
@@ -234,6 +271,11 @@ def main(argv=None) -> int:
         "in a bench/flight artifact, then exit",
     )
     ap.add_argument(
+        "--diagnose", default=None, metavar="FILE",
+        help="render SLO breach diagnoses from a diagnosis artifact, a "
+        "flight record, or a soak ledger with an 'slo' section, then exit",
+    )
+    ap.add_argument(
         "--flamegraph", nargs="?", const="-", default=None, metavar="DEST",
         help="render the merged profile samples (from --demo, or the "
         "profile windows of a flight record given via --from-snapshot) as "
@@ -250,6 +292,8 @@ def main(argv=None) -> int:
         return _print_flight(args.flight_recorder)
     if args.critical_path:
         return _print_critical_path(args.critical_path)
+    if args.diagnose:
+        return _print_diagnosis(args.diagnose)
     hub = None
     if args.demo:
         hub = _run_demo()

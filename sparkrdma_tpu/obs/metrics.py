@@ -156,6 +156,14 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "rpc.messages": ("counter", _L({"role", "type"})),
     "rpc.errors": ("counter", _L({"role"})),
     "rpc.handle_ms": ("histogram", _L({"role", "type"})),
+    # SLO engine + automated diagnosis (obs/slo.py, obs/diagnose.py)
+    "slo.evaluations": ("counter", _L({"role"})),
+    "slo.objectives": ("gauge", _L({"role"})),
+    "slo.breaches": ("counter", _L({"objective", "role", "severity"})),
+    "slo.breaching": ("gauge", _L({"role"})),
+    "slo.burn_rate": ("gauge", _L({"objective", "role", "window"})),
+    "diagnosis.builds": ("counter", _L({"role"})),
+    "diagnosis.build_ms": ("histogram", _L({"role"})),
     # cluster telemetry plane (obs/telemetry.py)
     "telemetry.heartbeats": ("counter", _L({"executor", "role"})),
     "telemetry.bad_payloads": ("counter", _L({"role"})),
@@ -266,8 +274,8 @@ def snapshot_delta(
 ) -> Dict[str, Dict[str, object]]:
     """Reset-safe diff of two ``snapshot()`` dicts.
 
-    Counters and histogram count/sum are differenced; gauges report
-    their current state. A *negative* difference means the instrument
+    Counters and histogram count/sum/per-bucket counts are differenced;
+    gauges report their current state. A *negative* difference means the instrument
     was zeroed (``reset()``) after ``prev`` was taken — the Prometheus
     counter-reset rule applies: the delta restarts from the current
     value instead of going negative, so a long-lived consumer holding a
@@ -287,14 +295,20 @@ def snapshot_delta(
         ph = prev_h.get(key, {})
         dc = h["count"] - ph.get("count", 0)
         ds = h["sum"] - ph.get("sum", 0.0)
-        if dc < 0 or ds < 0:
-            dc, ds = h["count"], h["sum"]
-        out["histograms"][key] = {
+        cur_b = h.get("buckets") or {}
+        prev_b = ph.get("buckets") or {}
+        db = {b: c - prev_b.get(b, 0) for b, c in cur_b.items()}
+        if dc < 0 or ds < 0 or any(v < 0 for v in db.values()):
+            dc, ds, db = h["count"], h["sum"], dict(cur_b)
+        entry: Dict[str, object] = {
             "count": dc,
             "sum": ds,
             "min": h["min"],
             "max": h["max"],
         }
+        if cur_b:
+            entry["buckets"] = db
+        out["histograms"][key] = entry
     return out
 
 

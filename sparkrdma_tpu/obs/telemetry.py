@@ -82,6 +82,22 @@ MAD_FALLBACK_FRACTION = 0.15
 MISSED_AFTER_INTERVALS = 2.5
 
 
+def _hist_payload(h: Mapping) -> dict:
+    """Wire form of one histogram delta: count/sum plus the FULL
+    per-bucket delta vector — what the hub's rings need to evaluate
+    latency SLOs. Zero entries are kept deliberately: the exceedance
+    snap (obs/slo.py) derives the instrument's bound set from the keys,
+    and a pruned vector would snap a threshold past absent bounds and
+    under-count real exceedances. Idle instruments (zero count delta)
+    are pruned entirely at the call site, so this costs nothing while
+    nothing happens."""
+    out = {"count": h["count"], "sum": h["sum"]}
+    buckets = h.get("buckets")
+    if buckets:
+        out["buckets"] = dict(buckets)
+    return out
+
+
 def _robust_z(value: float, values: List[float]) -> float:
     """Robust z-score of ``value`` within ``values`` (median/MAD)."""
     med = statistics.median(values)
@@ -100,7 +116,9 @@ class Heartbeater:
         {"v": 1, "executor_id": ..., "seq": n, "wall_ms": ...,
          "interval_ms": ..., "counters": {key: delta != 0},
          "gauges": {key: {"value", "hwm"}},
-         "histograms": {key: {"count": dc, "sum": ds}} (dc != 0)}
+         "histograms": {key: {"count": dc, "sum": ds,
+                              "buckets": <full per-bucket deltas>}
+                        for keys with dc != 0}
 
     With ``send`` the payload ships immediately (in-process hub);
     without, it lands in a bounded outbox the driver drains via the
@@ -163,7 +181,7 @@ class Heartbeater:
                 if g.get("value") or g.get("hwm")
             },
             "histograms": {
-                k: {"count": h["count"], "sum": h["sum"]}
+                k: _hist_payload(h)
                 for k, h in delta["histograms"].items()
                 if h["count"]
             },
@@ -325,12 +343,24 @@ class TelemetryHub:
         # cluster-wide merge of the executors' collapsed-stack profile
         # tables (heartbeat "profile" payloads, obs/profiler.py)
         self.profiles = ProfileHub(clock=clock)
+        # last critical-path TimeBreakdown the engine attributed — the
+        # diagnosis engine's dominant-category evidence (obs/attr.py)
+        self.last_breakdown: Optional[dict] = None
 
         reg = self._registry
         self._g_executors = reg.gauge("telemetry.executors", role=role)
         self._g_missed = reg.gauge("telemetry.missed_heartbeats", role=role)
         self._g_stragglers = reg.gauge("telemetry.stragglers", role=role)
         self._c_bad = reg.counter("telemetry.bad_payloads", role=role)
+
+        # SLO judgment layer: rides ingest() on its own cadence; every
+        # page/warn transition is answered with an automated root-cause
+        # diagnosis (obs/slo.py, obs/diagnose.py)
+        from sparkrdma_tpu.obs.slo import SLOEngine
+
+        self.slo = SLOEngine(self, conf, registry=self._registry,
+                             role=role, clock=clock)
+        self.slo.on_breach = self._on_slo_breach
 
         self._http = None
         if self._http_port > 0:
@@ -426,6 +456,7 @@ class TelemetryHub:
         ).inc()
         self.check_missed(now_ms=wall_ms)
         self._update_stragglers()
+        self.slo.maybe_evaluate(now_ms=wall_ms)
         self._maybe_write_file(wall_ms)
 
     def check_missed(self, now_ms: Optional[int] = None) -> List[str]:
@@ -480,6 +511,45 @@ class TelemetryHub:
         with self._lock:
             items = list(self._series.items())
         return {eid: ring.rollup(last) for eid, ring in items}
+
+    def ring_windows(self, last: Optional[int] = None) -> Dict[str, list]:
+        """Live per-executor :class:`Window` lists — the SLO engine's
+        burn-rate input (same data as :meth:`timeline`, un-serialized)."""
+        with self._lock:
+            items = list(self._series.items())
+        return {eid: ring.windows(last) for eid, ring in items}
+
+    def missed_executors(self) -> List[str]:
+        """Executors currently inside a counted heartbeat outage."""
+        with self._lock:
+            return sorted(e for e, v in self._missed_counted.items() if v)
+
+    def last_straggler_report(self) -> dict:
+        with self._lock:
+            return self._last_report
+
+    def source_health(self) -> Dict[str, str]:
+        """Circuit-breaker states, or {} when no registry is attached."""
+        return self._health.states() if self._health is not None else {}
+
+    def note_breakdown(self, breakdown: Optional[dict]) -> None:
+        """Record the engine's latest critical-path TimeBreakdown dict
+        as diagnosis evidence (best-effort; None is ignored)."""
+        if breakdown:
+            self.last_breakdown = breakdown
+
+    def _on_slo_breach(self, breach) -> None:
+        """Answer a page/warn transition with an automated root-cause
+        pass. Best-effort: diagnosis must never add a failure mode to
+        the ingest path that detected the breach."""
+        try:
+            from sparkrdma_tpu.obs.diagnose import build_diagnosis
+
+            diag = build_diagnosis(self, breach, registry=self._registry,
+                                   clock=self._clock)
+            self.slo.note_diagnosis(diag)
+        except Exception:
+            logger.exception("automated diagnosis failed")
 
     def summary(self) -> dict:
         """Compact hub view for ``metrics_snapshot()`` on the driver."""
@@ -672,6 +742,7 @@ class TelemetryHub:
             "source_health": (
                 self._health.states() if self._health is not None else {}
             ),
+            "slo": self.slo.summary(),
         }
         # last profile window per executor: the collapsed-stack view of
         # what each process's CPUs were doing just before the failure

@@ -56,6 +56,11 @@ class Window:
             else:
                 mine["count"] = mine.get("count", 0) + h.get("count", 0)
                 mine["sum"] = mine.get("sum", 0.0) + h.get("sum", 0.0)
+                theirs = h.get("buckets")
+                if theirs:
+                    mb = mine.setdefault("buckets", {})
+                    for b, c in theirs.items():
+                        mb[b] = mb.get(b, 0) + c
         self.gauges.update(other.gauges)
         self.wall_ms = max(self.wall_ms, other.wall_ms)
         self.seq = max(self.seq, other.seq)
@@ -133,6 +138,11 @@ class TimeSeriesRing:
                 agg = histograms.setdefault(k, {"count": 0, "sum": 0.0})
                 agg["count"] += h.get("count", 0)
                 agg["sum"] += h.get("sum", 0.0)
+                hb = h.get("buckets")
+                if hb:
+                    ab = agg.setdefault("buckets", {})
+                    for b, c in hb.items():
+                        ab[b] = ab.get(b, 0) + c
             gauges.update(w.gauges)
         return {"counters": counters, "histograms": histograms,
                 "gauges": gauges}

@@ -17,9 +17,11 @@ normalizes all of them into one per-metric trajectory:
 Output is ``TREND.json`` (full trajectories + deltas + skip log) and
 ``TREND.md`` (a markdown table per family).  With ``--check`` the tool exits
 nonzero when any tracked throughput row (``gbps`` series from the bench family)
-drops more than the regression threshold vs the previous round it appeared in,
-or when a ledger row cannot be classified.  CI runs ``--check`` so a perf
-regression or a schema drift fails the build the same way a broken test does.
+drops, or any tracked latency row (``p99`` series from the soak/workloads
+families) *rises*, by more than the regression threshold vs the previous round
+it appeared in — or when a ledger row cannot be classified.  CI runs
+``--check`` so a perf regression or a schema drift fails the build the same way
+a broken test does.
 
 Run as ``python -m sparkrdma_tpu.obs.trend``.
 """
@@ -55,6 +57,7 @@ STRING_METADATA_KEYS = {
     "verified",
     "executor_id",
     "map_sorter",
+    "gate_skip_reason",
 }
 
 # Numeric keys that describe the run rather than measure it (round index,
@@ -85,8 +88,10 @@ NUMERIC_METADATA_KEYS = {
 
 _LEDGER_RE = re.compile(r"^(BENCH|WORKLOADS|SOAK)_r(\d+)\.json$")
 
-# Gate: a tracked series (bench.* containing "gbps") regressing by more than
-# this fraction vs the previous round it appeared in fails --check.
+# Gate: a tracked series regressing by more than this fraction vs the previous
+# round it appeared in fails --check.  Tracked series are bench.* rows
+# containing "gbps" (regression = drop) and soak.*/workloads.* rows containing
+# "p99" (regression = rise — latency climbing is the failure mode).
 REGRESSION_THRESHOLD = 0.15
 NOISE_FLOOR_MIN = 0.05
 
@@ -247,18 +252,30 @@ def build_trend(root: str) -> Dict[str, Any]:
     latest_round = {fam: max(rs) for fam, rs in rounds_by_family.items()}
     regressions: List[Dict[str, Any]] = []
     for name, traj in trajectories.items():
-        if not (name.startswith("bench.") and "gbps" in name):
+        # Two tracked shapes: throughput rows (bench gbps series, regress DOWN)
+        # and latency rows (soak/workloads p99 series, regress UP).  Both share
+        # the same noise-floored gate threshold and stale-series exemption.
+        if name.startswith("bench.") and "gbps" in name:
+            direction = "down"
+        elif name.startswith(("soak.", "workloads.")) and "p99" in name:
+            direction = "up"
+        else:
             continue
         traj["tracked"] = True
-        if traj["latest_round"] != latest_round.get("bench"):
+        family = name.split(".", 1)[0]
+        if traj["latest_round"] != latest_round.get(family):
             traj["stale"] = True
             continue
         d = traj["rel_delta_latest"]
-        if d is not None and d < -gate_threshold:
+        if d is None:
+            continue
+        regressed = d < -gate_threshold if direction == "down" else d > gate_threshold
+        if regressed:
             pts = traj["points"]
             regressions.append(
                 {
                     "series": name,
+                    "direction": direction,
                     "prev_round": pts[-2]["round"],
                     "prev_value": pts[-2]["value"],
                     "round": pts[-1]["round"],
@@ -290,7 +307,8 @@ def render_markdown(trend: Dict[str, Any]) -> str:
         f"- rounds scanned: "
         + ", ".join(f"{fam} {rs}" for fam, rs in sorted(trend["rounds"].items())),
         f"- series: {trend['num_series']}, noise floor: {trend['noise_floor']:.1%},"
-        f" gate threshold (tracked gbps rows): -{trend['gate_threshold']:.1%}",
+        f" gate threshold: ±{trend['gate_threshold']:.1%}"
+        " (gbps rows gate on drops, p99 rows gate on rises)",
         f"- regressions: {len(trend['regressions'])},"
         f" skipped rows: {len(trend['skipped'])}, errors: {len(trend['errors'])}",
         "",
@@ -298,9 +316,10 @@ def render_markdown(trend: Dict[str, Any]) -> str:
     if trend["regressions"]:
         lines += ["## Regressions", ""]
         for r in trend["regressions"]:
+            what = "latency rose" if r.get("direction") == "up" else "throughput dropped"
             lines.append(
                 f"- **{r['series']}**: {r['prev_value']:g} (r{r['prev_round']:02d})"
-                f" -> {r['value']:g} (r{r['round']:02d}), {r['rel_delta']:+.1%}"
+                f" -> {r['value']:g} (r{r['round']:02d}), {r['rel_delta']:+.1%} ({what})"
             )
         lines.append("")
     for family in ("bench", "workloads", "soak"):
@@ -371,9 +390,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     for msg in trend["errors"]:
         print(f"trend: ERROR {msg}", file=sys.stderr)
     for r in trend["regressions"]:
+        what = "latency rose" if r.get("direction") == "up" else "throughput dropped"
         print(
             f"trend: REGRESSION {r['series']} {r['prev_value']:g} -> {r['value']:g}"
-            f" ({r['rel_delta']:+.1%}) at round r{r['round']:02d}",
+            f" ({r['rel_delta']:+.1%}, {what}) at round r{r['round']:02d}",
             file=sys.stderr,
         )
     if args.check:

@@ -1080,6 +1080,7 @@ class TpuShuffleManager:
         snap["source_health"] = self.health.states()
         if self.telemetry is not None:
             snap["telemetry"] = self.telemetry.summary()
+            snap["slo"] = self.telemetry.slo.summary()
         # the unified registry view: every instrument whose labels are
         # compatible with this manager's role (process-global metrics
         # without a role label are included)

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 DEFAULT_TENANT = "default"
 
@@ -93,6 +93,14 @@ def scoped(tenant: Optional[str], fn):
     return _run
 
 
+def declared_tenants(conf) -> List[str]:
+    """Tenant names a configuration declares up front (fair-share
+    weight entries), sorted. Per-tenant SLO objectives (obs/slo.py)
+    install one objective per declared tenant; tenants that only ever
+    appear at runtime ride the global objective instead."""
+    return sorted(conf.tenancy_weights)
+
+
 def parse_weights(spec: str) -> Dict[str, int]:
     """Parse a ``"alice:4,bob:1"`` weight spec (bad entries dropped)."""
     out: Dict[str, int] = {}
@@ -127,6 +135,7 @@ __all__ = [
     "tenant_scope",
     "scoped",
     "parse_weights",
+    "declared_tenants",
     "AdmissionController",
     "AdmissionTimeout",
     "AdmissionClosed",

@@ -81,6 +81,16 @@ DECLARED_KNOBS: Dict[str, str] = {
     "obs.profile.maxFrames": "deepest stack recorded per sample",
     "obs.profile.windowMs": "recent-sample window (flight records, "
                             "gap-frame annotation)",
+    "obs.slo.enabled": "SLO burn-rate engine on the telemetry hub",
+    "obs.slo.evalIntervalMs": "min period between SLO evaluations",
+    "obs.slo.taskP99Ms": "p99 task-latency objective target (0 = off)",
+    "obs.slo.queueWaitP99Ms": "p99 admission-wait objective (0 = off)",
+    "obs.slo.errorRatio": "fetch error-ratio budget (bad/total)",
+    "obs.slo.throughputFloorMBps": "write-throughput floor (0 = off)",
+    "obs.slo.fastWindows": "fast-burn horizon in ring windows",
+    "obs.slo.slowWindows": "slow-burn horizon in ring windows",
+    "obs.slo.fastBurn": "burn-rate multiple that pages",
+    "obs.slo.slowBurn": "burn-rate multiple that warns",
     "driverHost": "driver RPC host",
     "driverPort": "driver RPC port (0 = ephemeral, written back)",
     "executorPort": "executor listener port (0 = ephemeral)",
@@ -155,6 +165,7 @@ PATTERN_KNOBS = (
     "tenancy.quota.<seg>.mempoolBytes",
     "tenancy.quota.<seg>.hbmBytes",
     "tenancy.quota.<seg>.pageCacheBytes",
+    "obs.slo.tenant.<seg>.taskP99Ms",
 )
 
 
@@ -231,6 +242,16 @@ class TpuShuffleConf:
         if not (lo <= v <= hi):
             v = parse_bytes(default)
         return v
+
+    def _float(self, key: str, default: float, lo: float, hi: float) -> float:
+        raw = self._conf.get(PREFIX + key)
+        if raw is None:
+            return default
+        try:
+            v = float(raw)
+        except ValueError:
+            return default
+        return v if lo <= v <= hi else default
 
     def _bool(self, key: str, default: bool) -> bool:
         raw = self._conf.get(PREFIX + key)
@@ -398,6 +419,66 @@ class TpuShuffleConf:
         """Trailing window served to flight records and critical-path
         gap-frame annotation."""
         return self._int("obs.profile.windowMs", 2000, 100, 600000)
+
+    # -- SLO engine + automated diagnosis (obs/slo.py, obs/diagnose.py) ---
+    @property
+    def slo_enabled(self) -> bool:
+        """Evaluate declared objectives on the driver TelemetryHub."""
+        return self._bool("obs.slo.enabled", True)
+
+    @property
+    def slo_eval_interval_ms(self) -> int:
+        """Minimum period between SLO evaluation passes (the engine
+        rides the heartbeat ingest path on this cadence)."""
+        return self._int("obs.slo.evalIntervalMs", 2000, 100, 600000)
+
+    @property
+    def slo_task_p99_ms(self) -> int:
+        """p99 task-latency objective target in ms; 0 leaves the
+        objective uninstalled (no false pages on unknown workloads)."""
+        return self._int("obs.slo.taskP99Ms", 0, 0, 600000)
+
+    @property
+    def slo_queue_wait_p99_ms(self) -> int:
+        """p99 admission queue-wait objective target in ms; 0 = off."""
+        return self._int("obs.slo.queueWaitP99Ms", 0, 0, 600000)
+
+    @property
+    def slo_error_ratio(self) -> float:
+        """Error budget for the fetch error-ratio objective
+        (bad READs / total READs)."""
+        return self._float("obs.slo.errorRatio", 0.02, 1e-6, 1.0)
+
+    @property
+    def slo_throughput_floor_mbps(self) -> float:
+        """Active-window write-throughput floor in MB/s; 0 = off."""
+        return self._float("obs.slo.throughputFloorMBps", 0.0, 0.0, 1e9)
+
+    @property
+    def slo_fast_windows(self) -> int:
+        """Fast-burn (page) horizon in ring windows."""
+        return self._int("obs.slo.fastWindows", 8, 1, 65536)
+
+    @property
+    def slo_slow_windows(self) -> int:
+        """Slow-burn (warn) horizon in ring windows."""
+        return self._int("obs.slo.slowWindows", 32, 1, 65536)
+
+    @property
+    def slo_fast_burn(self) -> float:
+        """Burn-rate multiple of the error budget that pages."""
+        return self._float("obs.slo.fastBurn", 8.0, 1.0, 1e6)
+
+    @property
+    def slo_slow_burn(self) -> float:
+        """Burn-rate multiple of the error budget that warns."""
+        return self._float("obs.slo.slowBurn", 2.0, 1.0, 1e6)
+
+    def slo_tenant_task_p99_ms(self, tenant: str) -> int:
+        """Per-tenant p99 task-latency target; falls back to the global
+        ``obs.slo.taskP99Ms`` (0 = no objective for that tenant)."""
+        return self._int(f"obs.slo.tenant.{tenant}.taskP99Ms",
+                         self.slo_task_p99_ms, 0, 600000)
 
     # -- endpoints / connection management (RdmaShuffleConf.scala:118-126)
     @property
