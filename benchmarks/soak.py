@@ -314,6 +314,11 @@ def run_soak(args) -> dict:
             for k, v in snap_end["counters"].items()
             if k.startswith("admission.")
         },
+        "metastore": {
+            k: v
+            for k, v in snap_end["counters"].items()
+            if k.startswith("metastore.")
+        },
         "slo": slo_summary,
     }
 
@@ -611,6 +616,20 @@ def main() -> int:
             note="healthy soak must not page"))
         check("zero_diagnoses", judge(
             "zero-diagnoses", len(diagnoses), 0, "eq"))
+    # ---- control-plane HA gate: driver killed mid-job -----------------
+    # (docs/RESILIENCE.md "Control-plane HA"): the metadata hub was
+    # wiped while jobs were in flight, so on top of the zero-failure
+    # bar above, executors must have re-ADOPTED committed map outputs
+    # into the rebuilt hub — re-publish, never recompute
+    if chaos_mode and "driver:kill" in args.fault_plan:
+        adoptions = sum(
+            v for k, v in soak.get("metastore", {}).items()
+            if k.startswith("metastore.adoptions")
+        )
+        check("driver_kill_readopted", judge(
+            "driver-kill-readopted", adoptions, 1, "ge",
+            note="post-wipe publishes carrying the new generation must "
+                 "land as adoptions, not recomputes"))
     if args.strict:
         check("fairness_within_25pct", judge(
             "fairness-within-25pct", soak["fairness_max_rel_dev"],

@@ -105,7 +105,8 @@ class _SinkManager:
         pass
 
     def publish_partition_locations(
-        self, shuffle_id, partition_id, locations, num_map_outputs=0
+        self, shuffle_id, partition_id, locations, num_map_outputs=0,
+        meta_epoch=0,
     ) -> None:
         schedule_point("proto", "sink.publish")
         with self._pub_lock:
@@ -329,14 +330,19 @@ class ReplicaPromotionModel(ProtocolModel):
 
     def build(self, sched: CooperativeScheduler) -> None:
         from sparkrdma_tpu.analysis.lockorder import named_lock
+        from sparkrdma_tpu.metastore import ShardedMetaStore
         from sparkrdma_tpu.obs import get_registry
         from sparkrdma_tpu.obs.trace import Tracer
         from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
         from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+        from sparkrdma_tpu.utils.config import TpuShuffleConf
 
         # storage-only construction: the protocol methods under test
         # (_handle_publish, _on_peer_lost) are pure registry mutators —
-        # they need the driver-side dicts and locks, not a transport
+        # they need the driver-side dicts and locks, not a transport.
+        # The location registry itself is a REAL sharded metastore (the
+        # control-plane HA hub): publishes here run the epoch-fenced
+        # route/apply path, not a plain dict insert
         m = object.__new__(TpuShuffleManager)
         m.is_driver = True
         m.executor_id = "driver"
@@ -345,7 +351,7 @@ class ReplicaPromotionModel(ProtocolModel):
         m.telemetry = None
         m._lock = named_lock("manager.state", hot=True)
         m._shuffle_locks = {}
-        m._partition_locations = {}
+        m.metastore = ShardedMetaStore(TpuShuffleConf({}), role="driver")
         m._registered = {
             self.SID: BaseShuffleHandle(self.SID, self.NUM_MAPS, HashPartitioner(2))
         }
@@ -672,3 +678,230 @@ class QuotaModel(ProtocolModel):
 
     def result(self) -> bytes:
         return b"quota_stall"
+
+
+# ----------------------------------------------------------------------
+# model 5: sharded metastore under lease fencing, sweep, and driver
+# crash (sparkrdma_tpu/metastore, docs/RESILIENCE.md "Control-plane HA")
+# ----------------------------------------------------------------------
+@register_model
+class MetaLeaseModel(ProtocolModel):
+    """The REAL ShardedMetaStore under the three control-plane hazards
+    at once: a publisher racing its own ``sweep_executor`` tombstone, a
+    driver crash (``wipe``: entries gone, leases re-grant under bumped
+    epochs, generation advances) racing in-flight epoch-fenced writes,
+    and a re-adoption sweep from an OLDER takeover era racing the new
+    one (generation fencing). Time is an injected clock the chaos
+    thread advances past the lease TTL.
+
+    Threads: pub_a (exec-a's map, swept mid-flight), pub_b (exec-b's
+    map, survives), chaos (sweep exec-a -> wipe -> expire leases ->
+    generation-fenced adopt re-publish of exec-b), stale_pub (adopt
+    sweep fenced at the PRE-wipe generation — must die, not merge),
+    reader (epoch-fenced resolves).
+
+    Oracles: no entry predates the wipe (a write routed under a
+    pre-crash lease can never land in the post-crash registry); a dead
+    shard serves nothing; no tombstoned publisher's location survives
+    the final state; the stale-generation sweep leaves no trace; a
+    resolve never returns two copies of one (pid, source_map) slot
+    (follower double-serve); expired leases cannot renew or serve
+    without a takeover epoch bump. ``result()`` is the canonical final
+    registry — byte-identical across schedules.
+    """
+
+    name = "meta_lease"
+    SID = 5
+
+    def _locs(self, exec_id: str, map_id: int, mkey: int):
+        from sparkrdma_tpu.locations import (
+            BlockLocation,
+            PartitionLocation,
+            ShuffleManagerId,
+        )
+
+        mid = ShuffleManagerId("mc", 1, exec_id)
+        return [
+            PartitionLocation(
+                mid, pid, BlockLocation(0, 3, mkey + pid, source_map=map_id)
+            )
+            for pid in (0, 1)
+        ]
+
+    def build(self, sched: CooperativeScheduler) -> None:
+        from sparkrdma_tpu.metastore import ShardedMetaStore, StaleEpochError
+        from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+        self.now = [0.0]  # injected clock: ONLY chaos advances it
+        conf = TpuShuffleConf({
+            "tpu.shuffle.metastore.peers": 3,
+            "tpu.shuffle.metastore.vnodes": 4,
+            "tpu.shuffle.metastore.rangeSize": 1,
+            "tpu.shuffle.metastore.leaseTtlMs": 5000,
+            "tpu.shuffle.metastore.replicas": 1,
+            "tpu.shuffle.metastore.retryBackoffMs": 1,
+        })
+        self.store = ShardedMetaStore(
+            conf, role="mc-meta", clock=lambda: self.now[0]
+        )
+        self.StaleEpochError = StaleEpochError
+        self.gen0 = self.store.generation  # the pre-crash era
+        self.wipe_gen: Optional[int] = None
+        self.post_wipe_epochs: Dict[str, int] = {}
+        self.reads: List[List] = []
+        store = self.store
+
+        def pub(exec_id: str, map_id: int, mkey: int) -> None:
+            try:
+                store.publish(self.SID, self._locs(exec_id, map_id, mkey))
+            except StaleEpochError:
+                pass  # retry ladder exhausted: dropped whole, by contract
+
+        def chaos() -> None:
+            store.sweep_executor("exec-a", self.SID)
+            gen = store.wipe()
+            self.wipe_gen = gen
+            # record the post-crash epochs, then lapse every lease: any
+            # serving past this point must go through takeover
+            self.post_wipe_epochs = {
+                p: store._leases.epoch(p) for p in store.live_peers()
+            }
+            self.now[0] += store._leases.ttl_s + 1.0
+            try:
+                # the re-adoption sweep of the CURRENT era (an executor
+                # re-publishing its committed map, generation-fenced)
+                store.publish(
+                    self.SID, self._locs("exec-b", 1, 20),
+                    fence_generation=gen,
+                )
+            except StaleEpochError:
+                pass
+
+        def stale_pub() -> None:
+            # an adoption sweep still fenced at the PRE-wipe generation:
+            # before the wipe it applies (and is wiped with everything
+            # else); after it, it must be rejected whole
+            try:
+                store.publish(
+                    self.SID, self._locs("exec-stale", 2, 40),
+                    fence_generation=self.gen0,
+                )
+            except StaleEpochError:
+                pass
+
+        def reader() -> None:
+            for _ in range(2):
+                try:
+                    self.reads.append(store.resolve(self.SID, 0))
+                except StaleEpochError:
+                    pass
+
+        sched.spawn("pub_a", lambda: pub("exec-a", 0, 10))
+        sched.spawn("pub_b", lambda: pub("exec-b", 1, 20))
+        sched.spawn("chaos", chaos)
+        sched.spawn("stale_pub", stale_pub)
+        sched.spawn("reader", reader)
+
+    def _entries(self) -> List[Tuple[str, int, int, int]]:
+        """(executor, pid, source_map, gen_applied) across all shards."""
+        out = []
+        for shard in self.store._shards.values():
+            for (sid, pid), bucket in list(shard.entries.items()):
+                for loc, gen in list(bucket):
+                    out.append(
+                        (loc.manager_id.executor_id, pid,
+                         loc.block.source_map, gen)
+                    )
+        return out
+
+    def check(self) -> List[str]:
+        v: List[str] = []
+        for shard in self.store._shards.values():
+            if not shard.alive and shard.entries:
+                v.append(f"dead shard {shard.name} still holds entries")
+        if self.wipe_gen is not None:
+            for exec_id, pid, _sm, gen in self._entries():
+                if gen < self.wipe_gen:
+                    v.append(
+                        f"entry from {exec_id} pid {pid} predates the "
+                        f"wipe (applied gen {gen} < {self.wipe_gen}): a "
+                        f"pre-crash write landed in the post-crash "
+                        f"registry"
+                    )
+        for locs in self.reads:
+            slots: Dict[Tuple[int, int], int] = {}
+            for loc in locs:
+                k = (loc.partition_id, loc.block.source_map)
+                slots[k] = slots.get(k, 0) + 1
+            for (pid, sm), n in slots.items():
+                if n > 1:
+                    v.append(
+                        f"double-serve: resolve returned {n} copies of "
+                        f"partition {pid} map {sm}"
+                    )
+        return v
+
+    def final(self) -> List[str]:
+        v = self.check()
+        entries = self._entries()
+        execs = {e for e, _, _, _ in entries}
+        if "exec-a" in execs:
+            v.append(
+                "tombstoned publisher exec-a survives in the registry "
+                "(the per-shard sweep check has a window)"
+            )
+        if "exec-stale" in execs:
+            v.append(
+                "stale-generation adoption sweep merged into the new era"
+            )
+        for pid in (0, 1):
+            n = sum(
+                1 for e, p, _sm, _g in entries
+                if e == "exec-b" and p == pid
+            )
+            # one copy per owner (primary + follower), never more
+            if not 1 <= n <= 1 + self.store.replicas:
+                v.append(
+                    f"re-adoption incomplete or duplicated: exec-b pid "
+                    f"{pid} held {n} times (want 1..{1 + self.store.replicas})"
+                )
+        # expired leases must not serve without a takeover epoch bump
+        _, routed = self.store._route(self.SID, 0)
+        for peer, epoch in routed:
+            before = self.post_wipe_epochs.get(peer)
+            if before is not None and epoch <= before:
+                v.append(
+                    f"peer {peer} serves epoch {epoch} although its "
+                    f"lease lapsed at epoch {before}: expired lease "
+                    f"served without takeover"
+                )
+        # a renew carrying a superseded epoch must fence
+        leases = self.store._leases
+        peer = self.store.live_peers()[0]
+        cur = leases.epoch(peer)
+        if cur > 1:
+            try:
+                leases.renew(peer, cur - 1)
+                v.append("renew accepted a superseded epoch")
+            except self.StaleEpochError:
+                pass
+        # ... and so must a renew of a lapsed lease (re-acquire via
+        # takeover, never silently resurrect)
+        self.now[0] += leases.ttl_s + 1.0
+        try:
+            leases.renew(peer, leases.epoch(peer))
+            v.append("renew resurrected an expired lease")
+        except self.StaleEpochError:
+            pass
+        return v
+
+    def result(self) -> bytes:
+        # canonical final registry: primary-copy (executor, pid, map)
+        # triples — identical across schedules (exec-a swept, stale
+        # sweep dead, exec-b re-adopted exactly once per slot)
+        ents = self.store.entries_for_shuffle(self.SID)
+        return repr(sorted(
+            (loc.manager_id.executor_id, pid, loc.block.source_map)
+            for pid, locs in sorted(ents.items())
+            for loc in locs
+        )).encode()

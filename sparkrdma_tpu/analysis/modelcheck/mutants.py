@@ -76,6 +76,41 @@ MUTANTS: Dict[str, Tuple[str, str]] = {
         "quota_stall",
         "release bytes without notifying blocked chargers",
     ),
+    "meta-skip-epoch-check": (
+        "meta_lease",
+        "apply writes without the shard epoch fence: a write routed "
+        "under a pre-crash lease lands in the post-crash registry",
+    ),
+    "meta-tombstone-skip": (
+        "meta_lease",
+        "ignore per-shard executor tombstones: a swept publisher's "
+        "straggling locations double-serve beside promoted replicas",
+    ),
+    "meta-serve-follower": (
+        "meta_lease",
+        "resolve from every owner instead of the primary copy only: "
+        "one slot answers twice",
+    ),
+    "meta-lease-serve-expired": (
+        "meta_lease",
+        "leases never lapse: an expired holder keeps serving without "
+        "a takeover epoch bump",
+    ),
+    "meta-renew-after-expiry": (
+        "meta_lease",
+        "renew silently resurrects expired/superseded leases instead "
+        "of forcing re-acquire through takeover",
+    ),
+    "meta-adopt-no-bump": (
+        "meta_lease",
+        "driver-crash wipe advances neither generation nor lease "
+        "epochs: a stale re-adoption sweep merges into the new era",
+    ),
+    "meta-adopt-partial-sweep": (
+        "meta_lease",
+        "driver-crash wipe clears only one shard: pre-crash entries "
+        "survive into the post-crash registry",
+    ),
 }
 
 
@@ -250,6 +285,100 @@ def _arm_quota_silent_release() -> List[Tuple]:
     return [_patch(QuotaBroker, "release", release)]
 
 
+def _arm_meta_skip_epoch_check() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.store import MetaShard
+
+    return [_patch(MetaShard, "_epoch_ok", lambda self, epoch: True)]
+
+
+def _arm_meta_tombstone_skip() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.store import MetaShard
+
+    return [_patch(MetaShard, "_blocked", lambda self, executor_id: False)]
+
+
+def _arm_meta_serve_follower() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.store import ShardedMetaStore
+
+    return [
+        _patch(
+            ShardedMetaStore,
+            "_read_copies",
+            staticmethod(lambda owners: list(owners)),
+        )
+    ]
+
+
+def _arm_meta_lease_serve_expired() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.lease import LeaseTable
+
+    return [
+        _patch(
+            LeaseTable, "_expired", staticmethod(lambda lease, now: False)
+        )
+    ]
+
+
+def _arm_meta_renew_after_expiry() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.lease import LeaseTable, StaleEpochError
+
+    def renew(self, peer, epoch):
+        lease = self._leases.get(peer)
+        if lease is None:
+            raise StaleEpochError(peer, epoch, 0)
+        # BUG: no aliveness/epoch/expiry fence — a lapsed or superseded
+        # holder silently resurrects instead of re-acquiring via takeover
+        lease.deadline = self.clock() + self.ttl_s
+
+    return [_patch(LeaseTable, "renew", renew)]
+
+
+def _arm_meta_adopt_no_bump() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.store import ShardedMetaStore
+
+    def wipe(self):
+        # copied from wipe, fencing REMOVED: neither the generation nor
+        # the lease epochs advance, so a re-adoption sweep fenced at the
+        # pre-crash generation merges straight into the new era
+        schedule_point("proto", "meta.adopt")
+        with self._topology:
+            for peer in self._ring.peers:
+                shard = self._shards[peer]
+                with shard.lock:
+                    shard.entries.clear()
+            self._reg.gauge("metastore.epoch", role=self.role).set(
+                self.generation
+            )
+            return self.generation
+
+    return [_patch(ShardedMetaStore, "wipe", wipe)]
+
+
+def _arm_meta_adopt_partial_sweep() -> List[Tuple]:
+    from sparkrdma_tpu.metastore.store import ShardedMetaStore
+
+    def wipe(self):
+        # copied from wipe, sweep truncated: only the FIRST peer's slice
+        # clears, so pre-crash entries survive into the new generation
+        schedule_point("proto", "meta.adopt")
+        with self._topology:
+            self.generation += 1
+            self._leases.bump_all()
+            for i, peer in enumerate(self._ring.peers):
+                epoch = self._leases.epoch(peer)
+                shard = self._shards[peer]
+                with shard.lock:
+                    if i == 0:  # BUG: the other shards keep their entries
+                        shard.entries.clear()
+                    shard.epoch = epoch
+            self._reg.gauge("metastore.epoch", role=self.role).set(
+                self.generation
+            )
+            return self.generation
+
+    return [_patch(ShardedMetaStore, "wipe", wipe)]
+
+
 _ARMERS = {
     "merge-skip-dedup": _arm_merge_skip_dedup,
     "merge-seal-partial": _arm_merge_seal_partial,
@@ -262,6 +391,13 @@ _ARMERS = {
     "spec-skip-cancel": _arm_spec_skip_cancel,
     "quota-global-usage": _arm_quota_global_usage,
     "quota-silent-release": _arm_quota_silent_release,
+    "meta-skip-epoch-check": _arm_meta_skip_epoch_check,
+    "meta-tombstone-skip": _arm_meta_tombstone_skip,
+    "meta-serve-follower": _arm_meta_serve_follower,
+    "meta-lease-serve-expired": _arm_meta_lease_serve_expired,
+    "meta-renew-after-expiry": _arm_meta_renew_after_expiry,
+    "meta-adopt-no-bump": _arm_meta_adopt_no_bump,
+    "meta-adopt-partial-sweep": _arm_meta_adopt_partial_sweep,
 }
 
 

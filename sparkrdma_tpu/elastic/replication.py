@@ -90,6 +90,11 @@ class ReplicaStore:
         self._lock = named_lock("elastic.store")
         # shuffle_id -> [(registered segment, reserved bytes)]
         self._segments: Dict[int, List[Tuple[MemoryWriterBlock, int]]] = {}
+        # shuffle_id -> replica locations published from this store:
+        # the re-adoption ladder re-publishes these (lineage tags
+        # intact) after a hub wipe, so a pre-crash executor death still
+        # promotes instead of recomputing (sparkrdma_tpu/metastore)
+        self._published: Dict[int, List[PartitionLocation]] = {}
         self._stopped = False
         reg = get_registry()
         role = manager.executor_id
@@ -156,13 +161,34 @@ class ReplicaStore:
             )
             for pid, addr, length in offsets
         ]
+        with self._lock:
+            if not self._stopped:
+                self._published.setdefault(shuffle_id, []).extend(locs)
         manager.publish_partition_locations(shuffle_id, -1, locs, num_map_outputs=0)
         self._m_accepts.inc()
         return len(locs)
 
+    def republish(self, meta_epoch: int = 0) -> int:
+        """Re-publish every parked replica location (lineage tags
+        intact) toward a wiped hub — the replica half of the
+        re-adoption sweep. The segments themselves never moved; only
+        the registry forgot them. Returns locations re-published."""
+        with self._lock:
+            parked = {sid: list(locs) for sid, locs in self._published.items()}
+        count = 0
+        for shuffle_id, locs in sorted(parked.items()):
+            if not locs:
+                continue
+            self._manager.publish_partition_locations(
+                shuffle_id, -1, locs, num_map_outputs=0, meta_epoch=meta_epoch
+            )
+            count += len(locs)
+        return count
+
     def drop_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             segments = self._segments.pop(shuffle_id, [])
+            self._published.pop(shuffle_id, None)
         for seg, reserved in segments:
             seg.dispose()
             self._manager.resolver.release_inmemory_bytes(reserved)
@@ -230,21 +256,61 @@ class ReplicaClient:
             "blocks": blocks,
         }
         sent = 0
-        for dest in targets:
-            store = store_for(self._manager.conf.driver_port, dest)
-            try:
-                if store is not None:
-                    store.accept(shuffle_id, payload["source"], map_id, blocks)
-                elif dest in self.routes:
-                    self._send_socket(self.routes[dest], payload)
-                else:
-                    continue
-                sent += 1
-            except Exception:
-                # best-effort by contract: a failed replica is a silent
-                # durability miss, never a write failure
-                logger.debug("replicating to %s failed", dest, exc_info=True)
-                self._m_errors.inc()
+        # cluster mode: replica BYTES ride the data plane. The blocks
+        # are registered once in this node's ProtectionDomain and every
+        # socket target gets only (pid, mkey, length) descriptors over
+        # the task protocol, pulling the bytes with a one-sided READ
+        # before accepting (transport/staging.py); the synchronous task
+        # replies are the release signal for the registrations
+        staged = None
+        try:
+            for dest in targets:
+                store = store_for(self._manager.conf.driver_port, dest)
+                try:
+                    if store is not None:
+                        store.accept(
+                            shuffle_id, payload["source"], map_id, blocks
+                        )
+                    elif dest in self.routes:
+                        if staged is None and self._manager.node is not None:
+                            from sparkrdma_tpu.transport.staging import (
+                                stage_payloads,
+                            )
+
+                            data_addr, descs, release = stage_payloads(
+                                self._manager.node, [p for _, p in blocks]
+                            )
+                            staged = (
+                                dict(
+                                    payload,
+                                    blocks=[],
+                                    blocks_rd=[
+                                        (pid, mkey, length)
+                                        for (pid, _), (mkey, length) in zip(
+                                            blocks, descs
+                                        )
+                                    ],
+                                    data_addr=data_addr,
+                                ),
+                                release,
+                            )
+                        self._send_socket(
+                            self.routes[dest],
+                            staged[0] if staged is not None else payload,
+                        )
+                    else:
+                        continue
+                    sent += 1
+                except Exception:
+                    # best-effort by contract: a failed replica is a
+                    # silent durability miss, never a write failure
+                    logger.debug(
+                        "replicating to %s failed", dest, exc_info=True
+                    )
+                    self._m_errors.inc()
+        finally:
+            if staged is not None:
+                staged[1]()
         if sent:
             self._m_maps.inc()
             self._m_bytes.inc(total * sent)

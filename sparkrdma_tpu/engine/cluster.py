@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.engine.worker import _recv_obj, _send_obj
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner, Partitioner
 from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
@@ -370,6 +371,45 @@ class ClusterContext:
                 len(self._live_workers()),
             )
 
+    def _driver_failover(self) -> None:
+        """Control-plane HA chaos rig (the ``driver:kill`` fault): the
+        metadata hub dies mid-job and recovers by RE-PUBLISH, never
+        recompute. Three rungs (docs/RESILIENCE.md "Control-plane HA"):
+
+        1. wipe — every registry entry, barrier count, ownership claim,
+           and parked replica is gone; leases re-grant under bumped
+           epochs and the generation advances;
+        2. re-adoption sweep — every live executor re-publishes its
+           committed map outputs (rebuilt from the writer-committed
+           files) and parked replicas (lineage tags intact), fenced by
+           the new generation so a stale sweep can never merge in;
+        3. re-promotion — executors that died BEFORE the crash get
+           their loss replayed, so their re-parked replicas promote
+           again instead of recomputing."""
+        from sparkrdma_tpu.obs import get_registry
+
+        t0 = time.perf_counter()
+        generation = self.driver.metastore_crash()
+        for w in self._live_workers():
+            try:
+                w.request({"kind": "republish", "meta_epoch": generation})
+            except Exception:
+                logger.warning(
+                    "re-adoption sweep on %s failed", w.executor_id,
+                    exc_info=True,
+                )
+        with self.driver._lock:
+            lost = sorted(self.driver._lost_executors)
+        for exec_id in lost:
+            self.driver._on_peer_lost(exec_id)
+        get_registry().histogram(
+            "metastore.readoption_ms", role=self.driver.executor_id
+        ).observe((time.perf_counter() - t0) * 1e3)
+        logger.warning(
+            "driver failover complete: generation %d, %d pre-crash losses "
+            "replayed", generation, len(lost),
+        )
+
     def _elastic_recovery_ok(self) -> bool:
         """Executor-loss recovery needs per-map lineage tags on the
         published locations — only the wrapper writer provides them."""
@@ -439,6 +479,14 @@ class ClusterContext:
         the failed ranges on survivors."""
         from sparkrdma_tpu.elastic.speculation import SpeculativeReducePhase
 
+        # driver-death seam: the hub dies between map and reduce — the
+        # worst moment, every barrier complete, nothing fetched yet.
+        # The failover ladder must leave the reduce phase able to
+        # resolve every location it would have seen (chaos bar:
+        # byte-identical results, metastore.adoptions > 0)
+        plan = _faults.active()
+        if plan is not None and plan.on_driver(stage="reduce_phase"):
+            self._driver_failover()
         workers = self._live_workers()
         assignments = [
             (i, rng, workers[i]) for i, rng in enumerate(bounds) if rng[1] > rng[0]

@@ -131,6 +131,29 @@ class TpuContext:
         self.driver._on_peer_lost(lost.executor_id)
         lost.stop()
 
+    def _driver_failover(self) -> None:
+        """In-process control-plane HA chaos rig (the ``driver:kill``
+        fault): wipe the metadata hub, sweep every live executor's
+        committed map outputs and parked replicas back in (fenced by
+        the new generation), then replay pre-crash executor losses so
+        their re-parked replicas promote again — re-publish, never
+        recompute (docs/RESILIENCE.md "Control-plane HA")."""
+        t0 = time.perf_counter()
+        generation = self.driver.metastore_crash()
+        for executor in self.executors:
+            executor.republish_for_readoption(generation)
+        with self.driver._lock:
+            lost = sorted(self.driver._lost_executors)
+        for exec_id in lost:
+            self.driver._on_peer_lost(exec_id)
+        get_registry().histogram(
+            "metastore.readoption_ms", role=self.driver.executor_id
+        ).observe((time.perf_counter() - t0) * 1e3)
+        logger.warning(
+            "driver failover complete: generation %d, %d pre-crash "
+            "losses replayed", generation, len(lost),
+        )
+
     # ------------------------------------------------------------------
     def parallelize(self, data, num_partitions: int = None) -> RDD:
         n = num_partitions or len(self.executors)
@@ -287,6 +310,15 @@ class TpuContext:
                     "job.run", tenant=tenant, attempt=attempt
                 ) as jsp:
                     self.ensure_parents(rdd)
+                    # driver:kill chaos seam (testing/faults.py): the
+                    # hub dies between the map barrier and the reduce
+                    # fan-out — worst case for metadata loss — and the
+                    # job must finish byte-identical via re-adoption
+                    plan = _faults.active()
+                    if plan is not None and plan.on_driver(
+                        stage="reduce_phase"
+                    ):
+                        self._driver_failover()
                     order = list(range(rdd.num_partitions))
                     weights = self._partition_weights(rdd)
                     if weights:

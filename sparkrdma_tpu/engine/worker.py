@@ -158,6 +158,15 @@ class Worker:
         if kind == "finalize":
             self.manager.finalize_maps(req["shuffle_id"])
             return {"ok": True}
+        if kind == "republish":
+            # control-plane HA re-adoption ladder (sparkrdma_tpu/
+            # metastore): the driver's hub was wiped; re-publish every
+            # committed map output and parked replica this executor
+            # holds, fenced by the new generation
+            n = self.manager.republish_for_readoption(
+                req.get("meta_epoch", 0)
+            )
+            return {"ok": True, "result": n}
         if kind == "push_blocks":
             # push/merge plane ingest (shuffle/merge.py): the reply is
             # sent only after any seal-and-publish this batch triggers,
@@ -165,10 +174,29 @@ class Worker:
             ep = self.manager.merge_endpoint
             accepted = 0
             if ep is not None:
+                blocks = req.get("blocks") or []
+                if req.get("blocks_rd"):
+                    # descriptor mode (transport/staging.py): the bytes
+                    # are staged in the sender's ProtectionDomain —
+                    # pull them over the data plane before merging; the
+                    # reply below releases the sender's registrations
+                    from sparkrdma_tpu.transport.staging import pull_payloads
+
+                    payloads = pull_payloads(
+                        self.manager.node,
+                        req["data_addr"],
+                        [(mk, ln) for _, _, mk, ln in req["blocks_rd"]],
+                    )
+                    blocks = [
+                        (pid, seq, data)
+                        for (pid, seq, _, _), data in zip(
+                            req["blocks_rd"], payloads
+                        )
+                    ]
                 accepted = ep.push_blocks(
                     req["shuffle_id"],
                     req["source"],
-                    req.get("blocks") or [],
+                    blocks,
                     req.get("final"),
                     req.get("origin_span", 0),
                     req.get("origin_trace", 0),
@@ -226,11 +254,29 @@ class Worker:
             store = self.manager.replica_store
             accepted = 0
             if store is not None:
+                blocks = req.get("blocks") or []
+                if req.get("blocks_rd"):
+                    # descriptor mode (transport/staging.py): replica
+                    # bytes ride the data plane; the reply releases the
+                    # source's registrations
+                    from sparkrdma_tpu.transport.staging import pull_payloads
+
+                    payloads = pull_payloads(
+                        self.manager.node,
+                        req["data_addr"],
+                        [(mk, ln) for _, mk, ln in req["blocks_rd"]],
+                    )
+                    blocks = [
+                        (pid, data)
+                        for (pid, _, _), data in zip(
+                            req["blocks_rd"], payloads
+                        )
+                    ]
                 accepted = store.accept(
                     req["shuffle_id"],
                     req["source"],
                     req["map_id"],
-                    req.get("blocks") or [],
+                    blocks,
                 )
             return {"ok": True, "result": accepted}
         if kind == "handoff":

@@ -32,6 +32,7 @@ cause                 score  evidence source
 ====================  =====  ==========================================
 injected-fault          4.0  testing/faults.py plan actually fired
 dead-executor           3.5  hub missed-heartbeat accounting (PR 5)
+dead-metastore-peer     3.25 metastore.peer_kills / lease takeovers
 straggler               3.0  robust-z straggler report (PR 5)
 circuit-open            2.5  resilience SourceHealthRegistry states
 quota-backpressure      2.0  tenant.quota_blocks counters (PR 13)
@@ -68,6 +69,10 @@ logger = logging.getLogger(__name__)
 RUBRIC: Dict[str, float] = {
     "injected-fault": 4.0,
     "dead-executor": 3.5,
+    # a dead metadata peer degrades EVERY job's control plane (routes
+    # fail over, epochs fence in-flight publishes) but costs no shuffle
+    # bytes — between the dead executor and the straggler
+    "dead-metastore-peer": 3.25,
     "straggler": 3.0,
     "circuit-open": 2.5,
     "quota-backpressure": 2.0,
@@ -129,6 +134,22 @@ def _quota_evidence(registry: MetricsRegistry) -> Dict[str, int]:
             _, labels = parse_metric_key(key)
             tenant = labels.get("tenant", "")
             out[tenant] = out.get(tenant, 0) + int(v)
+    return out
+
+
+def _metastore_evidence(registry: MetricsRegistry) -> Dict[str, int]:
+    """Dead metadata peers (sparkrdma_tpu/metastore): ``kill_peer``
+    counts ``metastore.peer_kills`` and every route through the dead
+    shard's range pays a ``metastore.lease_takeovers`` failover —
+    control-plane degradation with zero shuffle bytes lost."""
+    snap = registry.snapshot(prefix="metastore.")
+    out: Dict[str, int] = {"peer_kills": 0, "lease_takeovers": 0}
+    for key, v in snap.get("counters", {}).items():
+        name, _ = parse_metric_key(key)
+        if name == "metastore.peer_kills":
+            out["peer_kills"] += int(v)
+        elif name == "metastore.lease_takeovers":
+            out["lease_takeovers"] += int(v)
     return out
 
 
@@ -194,6 +215,7 @@ def build_diagnosis(
     health = probe(lambda: hub.source_health(), {}) or {}
     missed = probe(lambda: list(hub.missed_executors()), [])
     quota = probe(lambda: _quota_evidence(reg), {})
+    metastore = probe(lambda: _metastore_evidence(reg), {})
     trend = probe(lambda: _trend_evidence(trend_dir), {})
     dominant = _dominant_category(breakdown)
     gap_frames = list(breakdown.get("gap_frames", []))[:5]
@@ -266,6 +288,15 @@ def build_diagnosis(
             f"executor {eid} stopped heartbeating",
             executor=eid, source="missed-heartbeat",
         )
+    if metastore.get("peer_kills", 0) > 0:
+        add_cause(
+            "dead-metastore-peer",
+            f"{metastore['peer_kills']} metadata peer(s) lost their "
+            f"shard lease; "
+            f"{metastore.get('lease_takeovers', 0)} route failover(s)",
+            source="metastore",
+            detail=dict(metastore),
+        )
     for eid in straggler_ids:
         flags = (stragglers.get("executors", {})
                  .get(eid, {}).get("flags", []))
@@ -325,6 +356,7 @@ def build_diagnosis(
             "open_circuits": open_circuits,
             "missed_heartbeats": missed,
             "quota_blocks": quota,
+            "metastore": metastore,
             "trend": trend,
         },
         "causes": causes,

@@ -115,6 +115,15 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "mempool.registrations": ("counter", _L()),
     "mempool.deregistrations": ("counter", _L()),
     "mempool.in_use_bytes": ("gauge", _L()),
+    # control-plane HA metadata hub (sparkrdma_tpu/metastore)
+    "metastore.shards": ("gauge", _L({"role"})),
+    "metastore.epoch": ("gauge", _L({"role"})),
+    "metastore.lease_renewals": ("counter", _L({"role"})),
+    "metastore.lease_takeovers": ("counter", _L({"role"})),
+    "metastore.stale_epoch_rejects": ("counter", _L({"role"})),
+    "metastore.peer_kills": ("counter", _L({"role"})),
+    "metastore.adoptions": ("counter", _L({"role"})),
+    "metastore.readoption_ms": ("histogram", _L({"role"})),
     # adaptive partition planner (shuffle/planner.py)
     "planner.splits": ("counter", _L({"role"})),
     "planner.coalesces": ("counter", _L({"role"})),

@@ -114,6 +114,13 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # ``follows`` edge — the publish→record and resolve→fetch legs of
     # the cross-role critical path (docs/OBSERVABILITY.md).
     origin_span: int = 0
+    # control-plane HA (sparkrdma_tpu/metastore): the metastore
+    # generation this publish routed against. Nonzero only on
+    # re-adoption sweeps after a driver crash — the receiving hub
+    # fences sweeps started under an older takeover. Carried in the
+    # 0xFFFA epoch extension; 0 emits no bytes (legacy frames stay
+    # byte-identical).
+    meta_epoch: int = 0
 
     # is_last(1) shuffle_id(4) partition_id(4) num_map_outputs(4)
     _HDR = struct.Struct(">Biii")
@@ -170,6 +177,16 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # span emit zero extension bytes — legacy frames stay byte-identical.
     _FLW_MARKER = 0xFFFB
     _FLW_ITEM = struct.Struct(">Q")
+    # message-level metastore-epoch extension (control-plane HA,
+    # sparkrdma_tpu/metastore): written AFTER the follows extension,
+    # BEFORE the trace extension. Same impossible-host-length marker
+    # trick with 0xFFFA. Layout: _EXT_HDR with count 1, then one
+    # meta_epoch(u8) — the metastore generation a re-adoption publish
+    # routed against, so a sweep started under an older takeover is
+    # fenced whole at the hub. Messages with epoch 0 emit zero
+    # extension bytes — legacy frames stay byte-identical.
+    _EPO_MARKER = 0xFFFA
+    _EPO_ITEM = struct.Struct(">Q")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
@@ -189,6 +206,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
         flw_fixed = (
             self._EXT_HDR.size + self._FLW_ITEM.size if self.origin_span else 0
         )
+        epo_fixed = (
+            self._EXT_HDR.size + self._EPO_ITEM.size if self.meta_epoch else 0
+        )
         budget = (
             seg_size
             - SEG_HEADER.size
@@ -199,6 +219,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             - mrg_fixed
             - ela_fixed
             - flw_fixed
+            - epo_fixed
         )
         if budget <= 0:
             raise ValueError(f"segment size {seg_size} too small")
@@ -266,6 +287,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
             if self.origin_span:
                 buf.write(self._EXT_HDR.pack(self._FLW_MARKER, 1))
                 buf.write(self._FLW_ITEM.pack(self.origin_span))
+            if self.meta_epoch:
+                buf.write(self._EXT_HDR.pack(self._EPO_MARKER, 1))
+                buf.write(self._EPO_ITEM.pack(self.meta_epoch))
             buf.write(self._TRACE_EXT.pack(self.trace_id))
             segments.append(self.frame(self.msg_type, buf.getvalue()))
         return segments
@@ -278,6 +302,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
         )
         locs = []
         origin_span = 0
+        meta_epoch = 0
         end = len(payload)
         # locations are each >= 28 bytes, so a residue of exactly 8 is
         # the trailing trace-id extension (absent from legacy senders);
@@ -373,13 +398,21 @@ class PublishPartitionLocationsMsg(RpcMsg):
                         if span:
                             origin_span = span
                     continue
+                if marker == cls._EPO_MARKER:
+                    for _ in range(count):
+                        (epoch,) = cls._EPO_ITEM.unpack(
+                            inp.read(cls._EPO_ITEM.size)
+                        )
+                        if epoch:
+                            meta_epoch = epoch
+                    continue
             inp.seek(pos)
             locs.append(PartitionLocation.read(inp))
         trace_id = 0
         if end - inp.tell() == cls._TRACE_EXT.size:
             (trace_id,) = cls._TRACE_EXT.unpack(inp.read(cls._TRACE_EXT.size))
         return cls(shuffle_id, partition_id, locs, bool(is_last), num_maps,
-                   trace_id, origin_span)
+                   trace_id, origin_span, meta_epoch)
 
 
 @dataclass

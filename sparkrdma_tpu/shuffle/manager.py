@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from sparkrdma_tpu.analysis.lockorder import OrderedLock, named_lock
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
+from sparkrdma_tpu.metastore import ShardedMetaStore, StaleEpochError
 from sparkrdma_tpu.obs import SpanHandle, Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
 from sparkrdma_tpu.obs.telemetry import TelemetryHub
@@ -82,7 +83,14 @@ class TpuShuffleManager:
 
         # driver state
         self._manager_ids: Dict[str, ShuffleManagerId] = {}
-        self._partition_locations: Dict[int, Dict[int, List[PartitionLocation]]] = {}
+        # the locations registry: sharded by (shuffle_id, partition
+        # range) across lease-replicated metadata peers (control-plane
+        # HA, sparkrdma_tpu/metastore). The old monolithic
+        # ``_partition_locations`` dict survives as a read-only
+        # property materializing the store's primary-copy view.
+        self.metastore: Optional[ShardedMetaStore] = (
+            ShardedMetaStore(conf, role=self.executor_id) if is_driver else None
+        )
         self._registered: Dict[int, BaseShuffleHandle] = {}
         # map-output tracking: fetch replies wait for shuffle completeness
         self._maps_done: Dict[int, int] = {}
@@ -310,6 +318,18 @@ class TpuShuffleManager:
                 shuffle_id, named_lock("manager.shuffle")
             )
 
+    @property
+    def _partition_locations(
+        self,
+    ) -> Dict[int, Dict[int, List[PartitionLocation]]]:
+        """Read-only primary-copy view of the sharded registry, in the
+        shape the monolithic dict always had (shuffle_id -> pid ->
+        locations). Kept for tests and diagnostics; every mutation
+        goes through the metastore's epoch-fenced publish/sweep."""
+        if self.metastore is None:
+            return {}
+        return self.metastore.all_entries()
+
     def _handle_hello(self, msg: ManagerHelloMsg) -> None:
         """Driver: record membership, connect back, announce to all (:121-161)."""
         if not self.is_driver:
@@ -383,11 +403,18 @@ class TpuShuffleManager:
         ) as rsp:
             locs: List[PartitionLocation] = []
             with self._shuffle_lock(msg.shuffle_id):
-                with self._lock:
-                    shuffle = self._partition_locations.get(msg.shuffle_id)
-                if shuffle is not None:
-                    for pid in range(msg.start_partition, msg.end_partition):
-                        locs.extend(shuffle.get(pid, ()))
+                assert self.metastore is not None
+                try:
+                    locs = self.metastore.resolve_range(
+                        msg.shuffle_id, msg.start_partition, msg.end_partition
+                    )
+                except StaleEpochError:
+                    # every retry re-routed into another takeover: serve
+                    # what we can (nothing) rather than wedge the reply
+                    logger.warning(
+                        "resolve of shuffle %d [%d:%d) exhausted epoch retries",
+                        msg.shuffle_id, msg.start_partition, msg.end_partition,
+                    )
             reply = PublishPartitionLocationsMsg(
                 msg.shuffle_id,
                 msg.start_partition,
@@ -468,12 +495,31 @@ class TpuShuffleManager:
                             reg.setdefault(loc.partition_id, []).append(loc)
                 return
             # writers publish with partition_id = -1; re-key every location
-            # by its own partition id (:68-95)
+            # by its own partition id (:68-95). Three phases:
+            #   1. under the shuffle lock: generation fence, swept-
+            #      publisher fast check, first-finisher ownership claim;
+            #   2. OUTSIDE it: per-shard epoch-fenced inserts (the
+            #      metastore re-routes and retries stale epochs through
+            #      the ladder);
+            #   3. under the shuffle lock again: barrier accounting —
+            #      AFTER the inserts landed, and only if the publisher
+            #      was not swept meanwhile (the per-shard tombstones
+            #      dropped its locations; counting it would complete a
+            #      barrier whose locations never landed).
+            assert self.metastore is not None
             to_reply: List[FetchPartitionLocationsMsg] = []
+            exec_id = (
+                msg.locations[0].manager_id.executor_id if msg.locations else ""
+            )
             with self._shuffle_lock(msg.shuffle_id):
-                with self._lock:
-                    shuffle = self._partition_locations.setdefault(msg.shuffle_id, {})
-                    handle = self._registered.get(msg.shuffle_id)
+                if msg.meta_epoch and msg.meta_epoch != self.metastore.generation:
+                    # a re-adoption sweep started under an older
+                    # takeover: reject it whole before it claims
+                    # ownership it could block a recompute with
+                    self.registry.counter(
+                        "metastore.stale_epoch_rejects", role=self.executor_id
+                    ).inc()
+                    return
                 # first-finisher-wins dedup for attributed map publishes:
                 # a speculative clone of a map whose original already
                 # published (or vice versa) is dropped whole, so the
@@ -485,7 +531,6 @@ class TpuShuffleManager:
                     and msg.locations[0].block.source_map >= 0
                 ):
                     map_id = msg.locations[0].block.source_map
-                    exec_id = msg.locations[0].manager_id.executor_id
                     if exec_id in self._lost_executors:
                         # publisher already swept by _on_peer_lost: its
                         # replicas were promoted and its counts pruned;
@@ -499,9 +544,36 @@ class TpuShuffleManager:
                             "elastic.publishes_dropped", role=self.executor_id
                         ).inc()
                         return
-                for loc in msg.locations:
-                    shuffle.setdefault(loc.partition_id, []).append(loc)
+            try:
+                self.metastore.publish(
+                    msg.shuffle_id, msg.locations,
+                    fence_generation=msg.meta_epoch,
+                )
+            except StaleEpochError:
+                # counted by the store; an adoption-era mismatch or an
+                # exhausted retry ladder drops the message whole — the
+                # barrier below never runs, so completeness stays honest
+                return
+            if msg.meta_epoch and msg.num_map_outputs > 0:
+                # a generation-matched re-publish after a hub wipe: the
+                # crashed registry just re-adopted this map's state
+                self.registry.counter(
+                    "metastore.adoptions", role=self.executor_id
+                ).inc()
+            with self._shuffle_lock(msg.shuffle_id):
+                with self._lock:
+                    handle = self._registered.get(msg.shuffle_id)
                 if msg.is_last and msg.num_map_outputs > 0:
+                    if exec_id and exec_id in self._lost_executors:
+                        # swept between the claim and the inserts: the
+                        # per-shard tombstones dropped the locations
+                        # (or the sweep pruned them); counting this
+                        # publish would complete a barrier whose
+                        # locations never landed (meta_lease model)
+                        self.registry.counter(
+                            "elastic.publishes_dropped", role=self.executor_id
+                        ).inc()
+                        return
                     done = self._maps_done.get(msg.shuffle_id, 0) + msg.num_map_outputs
                     self._maps_done[msg.shuffle_id] = done
                     if msg.locations:
@@ -509,15 +581,15 @@ class TpuShuffleManager:
                         # re-arms the barrier; empty publishes (maps with
                         # no output data) have nothing to lose and stay
                         # counted unconditionally
-                        exec_id = msg.locations[0].manager_id.executor_id
                         by_exec = self._maps_by_exec.setdefault(msg.shuffle_id, {})
                         by_exec[exec_id] = by_exec.get(exec_id, 0) + msg.num_map_outputs
                     if handle is not None and done >= handle.num_maps:
                         to_reply = self._deferred_fetches.pop(msg.shuffle_id, [])
             # feed the adaptive planner: per-partition byte totals of
             # ORIGINAL locations (merged segments re-cover the same
-            # bytes and would double-count)
-            if self.telemetry is not None and msg.partition_id < 0:
+            # bytes and would double-count; re-adoption publishes were
+            # counted the first time around)
+            if self.telemetry is not None and msg.partition_id < 0 and not msg.meta_epoch:
                 for loc in msg.locations:
                     if not loc.block.merged_cover:
                         # source executor = the DMA lane this block will
@@ -573,14 +645,12 @@ class TpuShuffleManager:
         if not self.is_driver:
             return
         schedule_point("proto", "manager.peer_lost")
+        assert self.metastore is not None
         with self._lock:
             self._manager_ids.pop(executor_id, None)
             self._lost_executors.add(executor_id)
-            shuffle_ids = (
-                set(self._partition_locations)
-                | set(self._maps_by_exec)
-                | set(self._replica_locations)
-            )
+            shuffle_ids = set(self._maps_by_exec) | set(self._replica_locations)
+        shuffle_ids |= set(self.metastore.shuffle_ids())
         for shuffle_id in shuffle_ids:
             promoted_maps: set = set()
             # per-shuffle seam OUTSIDE the shuffle lock: publishes for
@@ -588,17 +658,15 @@ class TpuShuffleManager:
             schedule_point("proto", "manager.peer_lost.shuffle")
             with self._shuffle_lock(shuffle_id):
                 with self._lock:
-                    shuffle = self._partition_locations.get(shuffle_id)
                     by_exec = self._maps_by_exec.get(shuffle_id)
                     replicas = self._replica_locations.get(shuffle_id)
                     owner_map = self._map_owner.get(shuffle_id)
-                if shuffle is not None:
-                    for pid in list(shuffle.keys()):
-                        shuffle[pid] = [
-                            loc
-                            for loc in shuffle[pid]
-                            if loc.manager_id.executor_id != executor_id
-                        ]
+                # tombstone + prune shard by shard: a publish racing this
+                # sweep either lands before a shard's sweep (pruned) or
+                # after it (dropped by the shard's tombstone) — the
+                # check holds PER SHARD, never per process
+                self.metastore.sweep_executor(executor_id, shuffle_id)
+                promoted_locs: List[PartitionLocation] = []
                 if replicas is not None:
                     # drop replicas the lost executor itself was holding,
                     # then promote its surviving replicas into the
@@ -632,12 +700,7 @@ class TpuShuffleManager:
                                     continue
                                 if sm >= 0:
                                     promoted_slots.add((pid, sm))
-                                if shuffle is None:
-                                    with self._lock:
-                                        shuffle = self._partition_locations.setdefault(
-                                            shuffle_id, {}
-                                        )
-                                shuffle.setdefault(loc.partition_id, []).append(loc)
+                                promoted_locs.append(loc)
                                 if loc.block.source_map >= 0:
                                     promoted_maps.add(loc.block.source_map)
                                     promoted_by_holder.setdefault(
@@ -669,6 +732,17 @@ class TpuShuffleManager:
                             by_exec[holder] = by_exec.get(holder, 0) + len(maps)
                             for m in maps:
                                 owner_map[m] = holder
+                if promoted_locs:
+                    # promoted replicas become primary REGISTRY entries:
+                    # epoch-fenced inserts like any publish (their
+                    # holders are live, so no tombstone drops them)
+                    try:
+                        self.metastore.publish(shuffle_id, promoted_locs)
+                    except StaleEpochError:
+                        logger.warning(
+                            "replica promotion for shuffle %d exhausted "
+                            "epoch retries", shuffle_id,
+                        )
                 if owner_map is not None:
                     # uncovered maps lose their owner: the recompute's
                     # re-publish must be accepted, not deduped away
@@ -766,6 +840,7 @@ class TpuShuffleManager:
         partition_id: int,
         locations: List[PartitionLocation],
         num_map_outputs: int = 0,
+        meta_epoch: int = 0,
     ) -> None:
         if self.conf.resilience_checksums:
             locations = self._checksummed(locations)
@@ -775,6 +850,7 @@ class TpuShuffleManager:
             locations,
             num_map_outputs=num_map_outputs,
             trace_id=self.tracer.trace_for(shuffle_id),
+            meta_epoch=meta_epoch,
         )
         self.registry.counter("writer.publishes", role=self.executor_id).inc()
         self.registry.counter("writer.locations_published", role=self.executor_id).inc(
@@ -793,6 +869,54 @@ class TpuShuffleManager:
                 msg.origin_span = sp.span_id
             ch = self.node.get_channel(self.conf.driver_host, self.conf.driver_port)
             ch.send_in_queue(FnListener(), msg.to_segments(self.conf.recv_wr_size))
+
+    def metastore_crash(self) -> int:
+        """Driver: model hub death (the ``driver:kill`` fault). Every
+        registry entry, barrier count, ownership claim, and parked
+        replica is gone; leases re-grant under bumped epochs and the
+        generation advances. What survives — registered handles,
+        deferred fetches, the lost-executor set — is exactly what a
+        restarted hub process re-derives from its own job state.
+        Returns the new generation; re-adoption sweeps
+        (:meth:`republish_for_readoption`) must carry it."""
+        assert self.is_driver and self.metastore is not None
+        generation = self.metastore.wipe()
+        with self._lock:
+            self._maps_done.clear()
+            self._maps_by_exec.clear()
+            self._map_owner.clear()
+            self._replica_locations.clear()
+            self._publish_origins.clear()
+        logger.warning(
+            "metastore wiped (driver crash); generation now %d", generation
+        )
+        return generation
+
+    def republish_for_readoption(self, meta_epoch: int = 0) -> int:
+        """Executor: re-publish every committed map output (and every
+        parked replica) so a wiped hub re-adopts authoritative state —
+        a re-publish sweep, never a recompute. Locations rebuild from
+        the writer-committed files (committed_map_locations) plus the
+        replica registry's lineage tags; ``meta_epoch`` fences the
+        sweep against a takeover that started after it. Returns how
+        many map publishes were sent."""
+        if self.node is None:
+            return 0  # never wrote anything: nothing to re-adopt
+        count = 0
+        for shuffle_id in self.resolver.shuffle_ids():
+            data = self.resolver.get_shuffle_data(shuffle_id)
+            fn = getattr(data, "committed_map_locations", None)
+            if fn is None:
+                continue
+            for _map_id, locs in sorted(fn(self.local_manager_id).items()):
+                self.publish_partition_locations(
+                    shuffle_id, -1, locs,
+                    num_map_outputs=1, meta_epoch=meta_epoch,
+                )
+                count += 1
+        if self.replica_store is not None:
+            count += self.replica_store.republish(meta_epoch)
+        return count
 
     def fetch_remote_partition_locations(
         self, shuffle_id: int, start_partition: int, end_partition: int
@@ -868,10 +992,8 @@ class TpuShuffleManager:
             )
         with self._lock:
             self._registered[handle.shuffle_id] = handle
-            self._partition_locations.setdefault(
-                handle.shuffle_id,
-                {pid: [] for pid in range(handle.num_partitions)},
-            )
+        assert self.metastore is not None
+        self.metastore.ensure_shuffle(handle.shuffle_id, handle.num_partitions)
         # mint the shuffle's trace id; it rides every Publish/Fetch frame
         # touching this shuffle so spans correlate across roles
         trace_id = mint_trace_id()
@@ -984,15 +1106,16 @@ class TpuShuffleManager:
                 return sizes
         out: Dict[int, int] = {}
         with self._shuffle_lock(shuffle_id):
-            with self._lock:
-                shuffle = self._partition_locations.get(shuffle_id)
-            if shuffle:
-                for pid, locs in shuffle.items():
-                    out[pid] = sum(
-                        loc.block.length
-                        for loc in locs
-                        if not loc.block.merged_cover
-                    )
+            shuffle = (
+                self.metastore.entries_for_shuffle(shuffle_id)
+                if self.metastore is not None else {}
+            )
+            for pid, locs in shuffle.items():
+                out[pid] = sum(
+                    loc.block.length
+                    for loc in locs
+                    if not loc.block.merged_cover
+                )
         return out
 
     def partition_lane_sizes(self, shuffle_id: int) -> Dict[str, Dict[int, int]]:
@@ -1013,8 +1136,9 @@ class TpuShuffleManager:
         if self.telemetry is not None:
             self.telemetry.drop_partition_bytes(shuffle_id)
         self.resolver.remove_shuffle(shuffle_id)
+        if self.metastore is not None:
+            self.metastore.drop_shuffle(shuffle_id)
         with self._lock:
-            self._partition_locations.pop(shuffle_id, None)
             self._registered.pop(shuffle_id, None)
             self._maps_done.pop(shuffle_id, None)
             self._deferred_fetches.pop(shuffle_id, None)

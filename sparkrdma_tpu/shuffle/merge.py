@@ -497,7 +497,7 @@ class PushClient:
                     payload.get("origin_trace", 0),
                 )
             elif dest in self.routes:
-                self._send_socket(self.routes[dest], payload)
+                self._send_staged(self.routes[dest], payload)
             else:
                 self._m_skipped.inc(max(1, len(blocks)))
                 return
@@ -509,6 +509,40 @@ class PushClient:
         if blocks:
             self._m_pushed_blocks.inc(len(blocks))
             self._m_pushed_bytes.inc(sum(len(p) for _, _, p in blocks))
+
+    def _send_staged(self, addr: Tuple[str, int], payload: dict) -> None:
+        """Cluster-mode send: block BYTES ride the data plane.
+
+        The payloads are registered in this node's ProtectionDomain and
+        only ``(pid, seq, mkey, length)`` descriptors travel the task
+        protocol; the receiving worker pulls the bytes with a one-sided
+        READ before merging (transport/staging.py). The synchronous
+        task reply doubles as the release signal for the
+        registrations."""
+        from sparkrdma_tpu.transport.staging import stage_payloads
+
+        node = self._manager.node
+        blocks = list(payload.get("blocks") or ())
+        if node is None or not blocks:
+            # no data plane up (or a pure `final` marker): the inline
+            # path is already control-plane sized
+            self._send_socket(addr, payload)
+            return
+        data_addr, descs, release = stage_payloads(
+            node, [p for _, _, p in blocks]
+        )
+        try:
+            self._send_socket(addr, dict(
+                payload,
+                blocks=[],
+                blocks_rd=[
+                    (pid, seq, mkey, length)
+                    for (pid, seq, _), (mkey, length) in zip(blocks, descs)
+                ],
+                data_addr=data_addr,
+            ))
+        finally:
+            release()
 
     @staticmethod
     def _send_socket(addr: Tuple[str, int], payload: dict) -> None:

@@ -89,6 +89,30 @@ class WrapperShuffleData(ShuffleData):
             for map_id, mf in items
         ]
 
+    def committed_map_locations(
+        self, manager_id
+    ) -> Dict[int, List[PartitionLocation]]:
+        """Control-plane HA (sparkrdma_tpu/metastore): rebuild the
+        publishable locations of every committed map output — the same
+        non-empty-partition collection WrapperShuffleWriter.stop()
+        published the first time. A wiped hub re-adopts from this sweep
+        instead of recomputing; an all-empty map yields [] and is still
+        re-published so the map-output barrier re-completes."""
+        with self._lock:
+            items = sorted(self._mapped.items())
+        return {
+            map_id: [
+                PartitionLocation(
+                    manager_id,
+                    pid,
+                    replace(mf.get_partition_location(pid), source_map=map_id),
+                )
+                for pid in range(mf.partition_count())
+                if mf.get_partition_location(pid).length > 0
+            ]
+            for map_id, mf in items
+        }
+
     def get_input_streams(self, partition_id: int) -> List[BinaryIO]:
         with self._lock:
             files = list(self._mapped.values())

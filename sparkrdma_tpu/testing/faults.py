@@ -21,6 +21,14 @@ seeded, config-driven *fault plan* hooked at four seams:
     ``exec:kill:N[:peer=<id>]`` hard-exits the worker process,
     ``exec:hang:N`` wedges the task thread — the elastic layer's chaos
     rig (docs/RESILIENCE.md "Elasticity")
+  - ``driver`` — driver/hub-death seam (engine phase boundaries):
+    ``driver:kill:N[:stage=reduce_phase]`` wipes the metadata hub
+    mid-job; the job must resume through the re-adoption ladder
+    (sparkrdma_tpu/metastore, docs/RESILIENCE.md "Control-plane HA")
+  - ``meta``  — metadata-peer-death seam (metastore route time):
+    ``meta:kill:N[:shard=meta-K]`` revokes one metadata peer's lease
+    and remaps its shard ranges; in-flight writes fence with a stale
+    epoch and retry against the former follower
 
 Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
 ``delay`` (sleep ``delay_ms`` then proceed), ``corrupt`` (flip one
@@ -39,7 +47,8 @@ Plans are spec strings — ``op:kind:count[:k=v[,k=v...]]`` joined with
 ``after=N`` (skip the first N matching ops), ``delay_ms=N``,
 ``peer=SUBSTR`` (match on the channel's peer description),
 ``stage=NAME`` (restrict a ``stage`` rule to one pipeline stage, e.g.
-``stage:corrupt:1:stage=decode``).
+``stage:corrupt:1:stage=decode``), ``shard=NAME`` (restrict a ``meta``
+rule to one metadata peer, e.g. ``meta:kill:1:shard=meta-0``).
 
 The plan installs process-globally (:func:`install` /
 :func:`uninstall` / the :func:`installed` context manager); the hot
@@ -59,7 +68,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-OPS = ("read", "send", "rpc", "stage", "push", "exec")
+OPS = ("read", "send", "rpc", "stage", "push", "exec", "driver", "meta")
 KINDS = ("fail", "delay", "corrupt", "drop", "kill", "hang", "enosys")
 
 
@@ -78,6 +87,7 @@ class FaultRule:
     delay_ms: int = 0
     peer: str = ""
     stage: str = ""  # restrict a "stage" rule to one pipeline stage
+    shard: str = ""  # restrict a "meta" rule to one metadata peer
 
     def __post_init__(self):
         if self.op not in OPS:
@@ -107,6 +117,7 @@ class FaultRule:
             delay_ms=int(opts.pop("delay_ms", 0)),
             peer=opts.pop("peer", ""),
             stage=opts.pop("stage", ""),
+            shard=opts.pop("shard", ""),
         )
 
 
@@ -149,7 +160,8 @@ class FaultPlan:
             )
 
     def _match(
-        self, op: str, peer: str, stage: str = "", kinds: Sequence[str] = ()
+        self, op: str, peer: str, stage: str = "",
+        kinds: Sequence[str] = (), shard: str = ""
     ) -> Optional[Tuple[FaultRule, int]]:
         """First applicable rule for this op, or None. Decrements its
         budget and returns (rule, global fire index) when it fires.
@@ -165,6 +177,8 @@ class FaultPlan:
                 if rule.peer and rule.peer not in peer:
                     continue
                 if rule.stage and rule.stage != stage:
+                    continue
+                if rule.shard and rule.shard != shard:
                     continue
                 self._seen[i] += 1
                 if self._seen[i] <= rule.after:
@@ -367,6 +381,34 @@ class FaultPlan:
 
             os._exit(1)
         time.sleep((rule.delay_ms or 600_000) / 1000.0)
+
+    def on_driver(self, stage: str = "") -> bool:
+        """Driver-death seam (control-plane HA chaos rig,
+        docs/RESILIENCE.md "Control-plane HA"): consulted by the job
+        engines at phase boundaries (stage ``reduce_phase`` between map
+        and reduce). ``driver:kill:N[:stage=]`` returns True — the
+        engine wipes the metadata hub (every registry entry, barrier
+        count, and parked replica gone; leases re-grant under bumped
+        epochs) and runs the re-adoption ladder. Only ``kill`` matches
+        here, so driver rules never burn budget at other seams."""
+        hit = self._match("driver", "", stage=stage, kinds=("kill",))
+        if hit is None:
+            return False
+        logger.warning("fault plan: driver kill at stage %s", stage or "?")
+        return True
+
+    def on_meta(self, shard: str = "") -> bool:
+        """Metadata-peer-death seam (sparkrdma_tpu/metastore): consulted
+        by the store at route time with the owner peer's name.
+        ``meta:kill:N[:shard=meta-K]`` returns True — the store revokes
+        that peer's lease, remaps its ranges, and the in-flight write
+        fences with a stale epoch and retries against the former
+        follower's copy. Only ``kill`` matches here."""
+        hit = self._match("meta", "", kinds=("kill",), shard=shard)
+        if hit is None:
+            return False
+        logger.warning("fault plan: metadata peer kill (%s)", shard or "?")
+        return True
 
 
 def _drop_channel(channel) -> None:

@@ -157,6 +157,13 @@ DECLARED_KNOBS: Dict[str, str] = {
     "elastic.speculation": "clone straggler tasks onto healthy peers",
     "elastic.speculationCheckMs": "straggler poll period while reducing",
     "elastic.maxRecoveries": "executor-loss recoveries per stage",
+    "metastore.peers": "logical metadata peers the registry shards over",
+    "metastore.vnodes": "virtual nodes per metadata peer on the hash ring",
+    "metastore.rangeSize": "consecutive partitions sharing one shard key",
+    "metastore.leaseTtlMs": "shard lease time-to-live",
+    "metastore.replicas": "follower copies per metadata shard (0 = off)",
+    "metastore.maxWriteAttempts": "epoch-fenced write attempts before failing",
+    "metastore.retryBackoffMs": "base backoff between stale-epoch retries",
 }
 
 # Knob families with a free segment (``<seg>`` = one dot-free token),
@@ -972,3 +979,48 @@ class TpuShuffleConf:
         fails. Each round re-runs only the dead executor's unaccounted
         maps on survivors and re-issues its reduce ranges."""
         return self._int("elastic.maxRecoveries", 2, 0, 64)
+
+    # -- metastore (control-plane HA; sparkrdma_tpu/metastore) ------------
+    @property
+    def metastore_peers(self) -> int:
+        """Logical metadata peers the locations registry shards over
+        (metastore/shardmap.py). Each peer serves its shards under a
+        lease; killing one remaps only its ranges."""
+        return self._int("metastore.peers", 4, 1, 64)
+
+    @property
+    def metastore_vnodes(self) -> int:
+        """Virtual nodes per peer on the consistent-hash ring; more
+        vnodes, smoother spread and smaller movement per kill."""
+        return self._int("metastore.vnodes", 16, 1, 256)
+
+    @property
+    def metastore_range_size(self) -> int:
+        """Consecutive partitions sharing one shard key, so a reduce
+        task's ``[start, end)`` resolve touches few shards."""
+        return self._int("metastore.rangeSize", 8, 1, 4096)
+
+    @property
+    def metastore_lease_ttl_ms(self) -> int:
+        """Shard lease time-to-live. A lapsed lease takes over under a
+        bumped epoch; writes routed under the old one are fenced."""
+        return self._int("metastore.leaseTtlMs", 5000, 10, 1 << 31)
+
+    @property
+    def metastore_replicas(self) -> int:
+        """Follower copies per metadata shard. Writes apply to primary
+        + followers; reads serve the primary only. At >= 1 a metadata
+        peer's death costs zero metadata loss."""
+        return self._int("metastore.replicas", 1, 0, 4)
+
+    @property
+    def metastore_max_write_attempts(self) -> int:
+        """Stale-epoch publish/resolve attempts (re-route + retry
+        through the PR 2 ladder) before surfacing the error."""
+        return self._int("metastore.maxWriteAttempts", 4, 1, 64)
+
+    @property
+    def metastore_retry_backoff_ms(self) -> int:
+        """Base backoff between stale-epoch retries (jittered,
+        exponential, capped at 8x)."""
+        return self._int("metastore.retryBackoffMs", 2, 1, 1 << 31)

@@ -1,0 +1,359 @@
+"""Control-plane HA (docs/RESILIENCE.md "Control-plane HA"): the
+sharded, lease-replicated metadata hub and driver-crash re-adoption.
+
+Layers under test, smallest to largest:
+
+- ``ShardMap`` properties — full cover and minimal movement, the two
+  guarantees that make a metadata-peer death invalidate only its own
+  partition ranges;
+- ``LeaseTable`` units — expiry, renewal fencing, takeover epochs;
+- ``ShardedMetaStore`` — stale-epoch reject + retry-ladder recovery,
+  the per-shard swept-publisher fence, and ``meta:kill`` fault
+  re-routing;
+- end to end — the driver's metadata hub killed between the map
+  barrier and the reduce fan-out, in-process AND with real worker
+  subprocesses: the job must complete byte-identically by executor
+  RE-ADOPTION (generation-fenced re-publish of committed map outputs
+  and parked replicas), never by recompute.
+"""
+
+import collections
+
+import pytest
+
+from sparkrdma_tpu.engine.cluster import ClusterContext
+from sparkrdma_tpu.engine.context import TpuContext
+from sparkrdma_tpu.locations import (
+    BlockLocation,
+    PartitionLocation,
+    ShuffleManagerId,
+)
+from sparkrdma_tpu.metastore import ShardedMetaStore
+from sparkrdma_tpu.metastore.lease import LeaseTable, StaleEpochError
+from sparkrdma_tpu.metastore.shardmap import ShardMap
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.testing import faults as _faults
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+WORDS = ["tpu", "shuffle", "rdma", "mesh", "ici", "dcn"]
+
+
+# ----------------------------------------------------------------------
+# shard map properties
+# ----------------------------------------------------------------------
+def test_shard_map_full_cover():
+    """Every (shuffle, partition) key has exactly one primary and a
+    deterministic, distinct follower list; partitions in the same
+    range share owners (one reduce span touches few shards)."""
+    ring = ShardMap([f"meta-{i}" for i in range(5)], vnodes=8,
+                    range_size=4)
+    for sid in range(3):
+        for pid in range(64):
+            owners = ring.owners(sid, pid, replicas=2)
+            assert len(owners) == 3
+            assert len(set(owners)) == 3
+            assert owners[0] == ring.primary(sid, pid)
+            assert all(o in ring.peers for o in owners)
+            assert owners == ring.owners(sid, pid, replicas=2)
+    for pid in range(0, 64, 4):
+        base = ring.owners(0, pid, replicas=1)
+        for off in range(1, 4):
+            assert ring.owners(0, pid + off, replicas=1) == base
+
+
+def test_shard_map_minimal_movement():
+    """Removing a peer remaps ONLY the keys that peer owned; adding a
+    peer steals keys only for itself. A metadata-peer death therefore
+    invalidates only its own ranges."""
+    peers = [f"meta-{i}" for i in range(6)]
+    ring = ShardMap(peers, vnodes=16, range_size=2)
+    keys = [(sid, pid) for sid in range(4) for pid in range(40)]
+    before = {k: ring.primary(*k) for k in keys}
+    dead = "meta-3"
+    assert dead in set(before.values()), "pick a peer that owns keys"
+
+    shrunk = ring.without_peer(dead)
+    for k in keys:
+        after = shrunk.primary(*k)
+        if before[k] == dead:
+            assert after != dead
+        else:
+            assert after == before[k]
+
+    grown = ring.with_peer("meta-99")
+    stolen = 0
+    for k in keys:
+        p = grown.primary(*k)
+        assert p == before[k] or p == "meta-99"
+        stolen += p == "meta-99"
+    assert stolen > 0
+
+
+# ----------------------------------------------------------------------
+# lease units (injected clock)
+# ----------------------------------------------------------------------
+def test_lease_expiry_renewal_and_takeover():
+    now = [0.0]
+    lt = LeaseTable(["meta-0", "meta-1"], ttl_s=5.0,
+                    clock=lambda: now[0])
+    assert lt.live("meta-0") and lt.epoch("meta-0") == 1
+
+    # renewal inside the TTL extends the deadline
+    now[0] = 4.0
+    lt.renew("meta-0", 1)
+    now[0] = 8.0
+    assert lt.live("meta-0")
+
+    # a write carrying the current epoch passes; a superseded one fences
+    lt.check("meta-0", 1)
+    with pytest.raises(StaleEpochError):
+        lt.check("meta-0", 0)
+
+    # expiry: past the deadline the lease is dead and renew fences
+    now[0] = 14.0
+    assert not lt.live("meta-0")
+    with pytest.raises(StaleEpochError):
+        lt.renew("meta-0", 1)
+
+    # takeover bumps the epoch and revives; the old epoch stays fenced
+    new_epoch = lt.takeover("meta-0")
+    assert new_epoch == 2
+    assert lt.live("meta-0")
+    with pytest.raises(StaleEpochError):
+        lt.check("meta-0", 1)
+    lt.check("meta-0", 2)
+    with pytest.raises(StaleEpochError):
+        lt.renew("meta-0", 1)  # superseded epoch cannot renew
+
+
+# ----------------------------------------------------------------------
+# store: stale-epoch reject + retry ladder, sweep fence, meta:kill
+# ----------------------------------------------------------------------
+def _store(extra=None, **kw):
+    conf = dict({
+        "tpu.shuffle.metastore.peers": "3",
+        "tpu.shuffle.metastore.vnodes": "8",
+        "tpu.shuffle.metastore.rangeSize": "2",
+        "tpu.shuffle.metastore.retryBackoffMs": "1",
+    }, **(extra or {}))
+    return ShardedMetaStore(TpuShuffleConf(conf), role="test-meta", **kw)
+
+
+def _locs(exec_id, map_id, pids, mkey=100):
+    mid = ShuffleManagerId("127.0.0.1", 1, exec_id)
+    return [
+        PartitionLocation(
+            mid, pid, BlockLocation(0, 3, mkey + pid, source_map=map_id)
+        )
+        for pid in pids
+    ]
+
+
+def test_stale_generation_sweep_rejected_whole():
+    """A re-adoption sweep fenced by an older takeover generation must
+    be rejected at entry (counted), never merged into the new era."""
+    reg = get_registry()
+    store = _store()
+    gen0 = store.generation
+    rejects0 = reg.counter(
+        "metastore.stale_epoch_rejects", role="test-meta").value
+
+    assert store.publish(1, _locs("exec-a", 0, range(4))) == 4
+    gen1 = store.wipe()
+    assert gen1 > gen0
+    with pytest.raises(StaleEpochError):
+        store.publish(1, _locs("exec-a", 0, range(4)),
+                      fence_generation=gen0)
+    assert reg.counter(
+        "metastore.stale_epoch_rejects", role="test-meta"
+    ).value == rejects0 + 1
+    assert store.resolve(1, 0) == []
+
+    # the CURRENT generation's sweep lands
+    assert store.publish(1, _locs("exec-a", 0, range(4)),
+                         fence_generation=gen1) == 4
+    assert len(store.resolve(1, 0)) == 1
+
+
+def test_stale_epoch_apply_retries_through_ladder():
+    """A shard-side epoch fence mid-publish is retried through the
+    retry ladder and succeeds once the route re-resolves."""
+    reg = get_registry()
+    store = _store()
+    rejects0 = reg.counter(
+        "metastore.stale_epoch_rejects", role="test-meta").value
+    orig = store._apply
+    flaked = {"n": 0}
+
+    def flaky_apply(key, locs, routed, gen):
+        if flaked["n"] == 0:
+            flaked["n"] += 1
+            raise StaleEpochError("meta-0", 1, 2)
+        return orig(key, locs, routed, gen)
+
+    store._apply = flaky_apply
+    assert store.publish(2, _locs("exec-a", 0, [0])) == 1
+    assert flaked["n"] == 1
+    assert reg.counter(
+        "metastore.stale_epoch_rejects", role="test-meta"
+    ).value == rejects0 + 1
+    assert len(store.resolve(2, 0)) == 1
+
+
+def test_sweep_executor_fences_per_shard():
+    """The swept-publisher fence holds PER SHARD: after sweeping
+    exec-a from shuffle 1, its entries are gone from every shard of
+    that shuffle, later publishes from it drop silently, and exec-b
+    (and exec-a's entries in OTHER shuffles) survive."""
+    store = _store()
+    assert store.publish(1, _locs("exec-a", 0, range(8))) == 8
+    assert store.publish(1, _locs("exec-b", 1, range(8), mkey=500)) == 8
+    assert store.publish(7, _locs("exec-a", 2, range(4))) == 4
+
+    store.sweep_executor("exec-a", shuffle_id=1)
+    for pid in range(8):
+        owners = {loc.manager_id.executor_id
+                  for loc in store.resolve(1, pid)}
+        assert owners == {"exec-b"}
+    # tombstoned: a straggling publish from the swept executor drops
+    assert store.publish(1, _locs("exec-a", 0, range(8))) == 0
+    # scoped: other shuffles keep exec-a
+    assert len(store.resolve(7, 0)) == 1
+
+
+def test_meta_kill_fault_reroutes_publish():
+    """``meta:kill:<n>[:shard=]`` (testing/faults.py): the routed peer
+    dies mid-route; the store revokes its lease, shrinks the ring, and
+    the publish lands on the surviving peers — full cover holds."""
+    reg = get_registry()
+    kills0 = reg.counter("metastore.peer_kills", role="test-meta").value
+    with _faults.installed("meta:kill:1:shard=meta-1", seed=0):
+        store = _store()
+        assert store.publish(3, _locs("exec-a", 0, range(16))) == 16
+    assert "meta-1" not in store.live_peers()
+    assert reg.counter(
+        "metastore.peer_kills", role="test-meta").value == kills0 + 1
+    for pid in range(16):
+        locs = store.resolve(3, pid)
+        assert len(locs) == 1, f"pid {pid} lost by the failover"
+
+
+# ----------------------------------------------------------------------
+# end to end: driver hub killed mid-job
+# ----------------------------------------------------------------------
+def _wordcount(ctx):
+    data = [(WORDS[(i * 7) % len(WORDS)], 1) for i in range(3000)]
+    rdd = ctx.parallelize(data, 6).reduce_by_key(lambda a, b: a + b)
+    return sorted(rdd.collect())
+
+
+def test_driver_kill_in_process_byte_identity():
+    """In-process topology: the hub dies between the map barrier and
+    the reduce fan-out. The job completes byte-identical to a healthy
+    run and the rebuilt hub was repopulated by adoption."""
+    reg = get_registry()
+    with TpuContext(num_executors=2) as ctx:
+        baseline = _wordcount(ctx)
+
+    a0 = reg.counter("metastore.adoptions", role="driver").value
+    conf = TpuShuffleConf({
+        "tpu.shuffle.faultPlan": "driver:kill:1:stage=reduce_phase",
+    })
+    try:
+        with TpuContext(num_executors=2, conf=conf) as ctx:
+            got = _wordcount(ctx)
+    finally:
+        _faults.uninstall()
+    assert got == baseline
+    assert reg.counter("metastore.adoptions", role="driver").value > a0
+
+
+# NOTE on closures: cluster task functions come from factories so
+# cloudpickle serializes them BY VALUE — worker subprocesses cannot
+# import this test module by name.
+def _make_map(seed, n=600):
+    def fn():
+        for i in range(n):
+            yield (WORDS[(seed * 7 + i) % len(WORDS)], 1)
+
+    return fn
+
+
+def _counts_reducer():
+    def red(it):
+        acc = collections.Counter()
+        for k, v in it:
+            acc[k] += v
+        return dict(acc)
+
+    return red
+
+
+def _expected(num_maps, n=600):
+    expected = collections.Counter()
+    for s in range(num_maps):
+        for i in range(n):
+            expected[WORDS[(s * 7 + i) % len(WORDS)]] += 1
+    return expected
+
+
+def _merged(parts):
+    merged = collections.Counter()
+    for p in parts:
+        merged.update(p)
+    return merged
+
+
+def test_driver_kill_cluster_byte_identity():
+    """Real worker subprocesses: the driver's hub is wiped at the
+    reduce-phase entry; every worker answers the republish sweep and
+    the job finishes byte-identical with adoptions counted."""
+    reg = get_registry()
+    a0 = reg.counter("metastore.adoptions", role="driver").value
+    conf = TpuShuffleConf({
+        "tpu.shuffle.faultPlan": "driver:kill:1:stage=reduce_phase",
+    })
+    try:
+        with ClusterContext(num_executors=3, conf=conf) as cc:
+            parts = cc.run_map_reduce(
+                [_make_map(s) for s in range(6)], num_partitions=6,
+                reduce_fn=_counts_reducer(),
+            )
+    finally:
+        _faults.uninstall()
+    assert _merged(parts) == _expected(6)
+    assert reg.counter("metastore.adoptions", role="driver").value > a0
+
+
+def test_driver_kill_then_exec_kill_readopts_replicas_zero_recompute():
+    """The headline chaos bar: hub wiped at reduce-phase entry, THEN
+    an executor hard-killed mid-reduce. The re-adoption sweep must
+    restore the parked replica lineage (0xFFFC tags) into the rebuilt
+    hub, so the executor loss promotes replicas instead of recomputing
+    — byte-identical result, ZERO recomputed maps."""
+    reg = get_registry()
+    rec0 = reg.counter("elastic.recomputed_maps", role="driver").value
+    promos0 = reg.counter(
+        "elastic.replica_promotions", role="driver").value
+    a0 = reg.counter("metastore.adoptions", role="driver").value
+    conf = TpuShuffleConf({
+        "tpu.shuffle.faultPlan": (
+            "driver:kill:1:stage=reduce_phase;"
+            "exec:kill:1:peer=proc-exec-1,stage=reduce_task"
+        ),
+        "tpu.shuffle.elastic.replicas": "1",
+    })
+    try:
+        with ClusterContext(num_executors=3, conf=conf) as cc:
+            parts = cc.run_map_reduce(
+                [_make_map(s) for s in range(6)], num_partitions=6,
+                reduce_fn=_counts_reducer(),
+            )
+    finally:
+        _faults.uninstall()
+    assert _merged(parts) == _expected(6)
+    assert reg.counter("metastore.adoptions", role="driver").value > a0
+    assert reg.counter(
+        "elastic.replica_promotions", role="driver").value > promos0
+    assert reg.counter(
+        "elastic.recomputed_maps", role="driver").value == rec0
