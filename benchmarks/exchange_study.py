@@ -26,12 +26,14 @@ Usage:
     python benchmarks/exchange_study.py                 # full study -> EXCHANGE_r05.json
     python benchmarks/exchange_study.py --quick         # CI-sized subset, no file
     python benchmarks/exchange_study.py --stage-ab      # stage-level schedule A/B
-                                                        #   -> BENCH_r06.json
+                                                        #   -> BENCH_r08.json
 
 The ``--stage-ab`` mode (DESIGN.md §22) measures one whole reduce
-stage three ways on an in-process cluster — per-block device pull
-(collective compiler off), compiled collective waves, and fused
-fetch+merge — asserts the three land byte-identical partitions, and
+stage four ways on an in-process cluster — per-block device pull
+(collective compiler off), compiled collective waves (pipeline depth
+1), double-buffered pipelined waves (depth 2, wave_overlap_ms > 0
+asserted), and fused fetch+merge — asserts all four land
+byte-identical partitions, and
 reports each against the exchange-loopback roofline measured on the
 SAME mesh in the same process (``*_roofline_fraction`` fields)."""
 
@@ -214,14 +216,35 @@ def run_stage_ab_child(nblocks: int, block_bytes: int, reps: int) -> None:
                     )
                     assert have == want_sets[p], f"{mode}: pid {p} corrupt"
 
+        # mode matrix is the A/B: the tuner would re-cut budgets
+        # between reps and blur it, so it sits this bench out
+        conf.set("tpu.shuffle.collective.autoTune", "false")
+        from sparkrdma_tpu.obs import get_registry
+
+        overlap_c = get_registry().counter(
+            "collective.wave_overlap_ms", role="ab-red"
+        )
+        # a cut that forms several waves per stage — what the pipelined
+        # mode needs in flight; the single-wave modes keep the default
+        pipelined_cut = max(64 * 1024, round_bucket(total // 8))
+
         def run_mode(mode):
             conf.set(
                 "tpu.shuffle.collective.enabled",
                 "false" if mode == "per_block" else "true",
             )
+            conf.set(
+                "tpu.shuffle.collective.pipelineDepth",
+                "2" if mode == "pipelined" else "1",
+            )
+            conf.set(
+                "tpu.shuffle.collective.waveBytes",
+                str(pipelined_cut) if mode == "pipelined" else "64m",
+            )
             warm = fetch(mode)  # warmup: compile + correctness gate
             verify(mode, warm)
             free(warm)
+            o0 = overlap_c.value
             times = []
             for _ in range(reps):
                 t0 = time.perf_counter()
@@ -233,11 +256,23 @@ def run_stage_ab_child(nblocks: int, block_bytes: int, reps: int) -> None:
                 "step_s_median": round(med, 6),
                 "step_s_min": round(min(times), 6),
                 "gbps_cpu_only": round(total / med / 1e9, 4),
+                "overlap_ms": round(overlap_c.value - o0, 3),
                 "verified": True,
             }
 
-        modes = {m: run_mode(m) for m in ("per_block", "collective", "fused")}
+        modes = {
+            m: run_mode(m)
+            for m in ("per_block", "collective", "pipelined", "fused")
+        }
         conf.set("tpu.shuffle.collective.enabled", "true")
+        # the pipelining A/B proof: depth 1 cannot overlap by
+        # construction, depth 2 must (issue while consume runs)
+        assert modes["collective"]["overlap_ms"] == 0.0, (
+            "depth-1 collective recorded overlap"
+        )
+        assert modes["pipelined"]["overlap_ms"] > 0.0, (
+            "depth-2 pipelined mode recorded no overlap"
+        )
 
         # exchange-loopback roofline on the SAME mesh, same process:
         # the compiled collective's ceiling is what one fused exchange
@@ -268,16 +303,26 @@ def run_stage_ab_child(nblocks: int, block_bytes: int, reps: int) -> None:
             "reps": reps,
             "per_block_pull": modes["per_block"],
             "compiled_collective": modes["collective"],
+            "pipelined_collective": modes["pipelined"],
             "fused_fetch_merge": modes["fused"],
+            "pipeline_depth": 2,
+            "pipelined_wave_bytes": pipelined_cut,
+            "pipelined_overlap_ms": modes["pipelined"]["overlap_ms"],
             "exchange_loopback_gbps": round(roof_gbps, 4),
             "collective_roofline_fraction": round(
                 modes["collective"]["gbps_cpu_only"] / roof_gbps, 4
+            ),
+            "pipelined_roofline_fraction": round(
+                modes["pipelined"]["gbps_cpu_only"] / roof_gbps, 4
             ),
             "fused_roofline_fraction": round(
                 modes["fused"]["gbps_cpu_only"] / roof_gbps, 4
             ),
             "collective_speedup_vs_per_block": round(
                 modes["collective"]["gbps_cpu_only"] / max(per_block, 1e-9), 3
+            ),
+            "pipelined_speedup_vs_per_block": round(
+                modes["pipelined"]["gbps_cpu_only"] / max(per_block, 1e-9), 3
             ),
             "fused_speedup_vs_per_block": round(
                 modes["fused"]["gbps_cpu_only"] / max(per_block, 1e-9), 3
@@ -288,9 +333,11 @@ def run_stage_ab_child(nblocks: int, block_bytes: int, reps: int) -> None:
                 "issue/DMA latency here, so the amortization the "
                 "collective exists for (BENCH_r05's ~20x exchange-vs-"
                 "host gap) cannot show in the speedup column on this "
-                "rig. What transfers: byte identity across all three "
-                "paths, the roofline fractions vs the same-mesh "
-                "exchange, and the compile-once wave/program shapes."
+                "rig. What transfers: byte identity across all four "
+                "paths, the depth-2 overlap counter going positive "
+                "while depth 1 stays zero, the roofline fractions vs "
+                "the same-mesh exchange, and the compile-once "
+                "wave/program shapes."
             ),
         }
         print("RESULT " + json.dumps(record), flush=True)
@@ -429,10 +476,10 @@ def main() -> None:
     ap.add_argument(
         "--stage-ab", action="store_true",
         help="stage-level schedule A/B (per-block vs collective vs "
-             "fused, DESIGN.md §22) -> BENCH_r06.json",
+             "pipelined vs fused, DESIGN.md §22) -> BENCH_r08.json",
     )
     ap.add_argument(
-        "--stage-out", default=os.path.join(ROOT, "BENCH_r06.json"))
+        "--stage-out", default=os.path.join(ROOT, "BENCH_r08.json"))
     ap.add_argument("--child", nargs=4, metavar=("E", "SLICES", "BLOCKS", "REPS"))
     ap.add_argument("--dist-child", nargs=4, metavar=("PID", "NPROCS", "BLOCK", "REPS"))
     ap.add_argument(
@@ -457,8 +504,10 @@ def main() -> None:
             "label": (
                 "Stage-level schedule A/B on the 8-virtual-device CPU "
                 "mesh: per-block device pull vs compiled collective vs "
-                "fused fetch+merge, byte-identity asserted per mode, "
-                "roofline = exchange loopback on the same mesh."
+                "double-buffered pipelined waves vs fused fetch+merge, "
+                "byte-identity asserted per mode, depth-2 overlap "
+                "counter asserted positive, roofline = exchange "
+                "loopback on the same mesh."
             ),
             "host": {"nproc": os.cpu_count(), "platform": sys.platform},
             "parsed": record,

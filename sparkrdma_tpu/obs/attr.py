@@ -128,6 +128,51 @@ class TimeBreakdown:
         return "\n".join(lines)
 
 
+# ----------------------------------------------------------------------
+# process-local feedback seam: the last breakdown any producer built.
+# ``critpath.job_breakdown`` publishes here so consumers that close a
+# loop on attribution evidence — today the wave self-tuner
+# (shuffle/autotune.py) — read the verdict without holding a reference
+# to whichever engine/context produced it. Advisory by design: a stale
+# or missing breakdown only makes the consumer more conservative.
+# ----------------------------------------------------------------------
+_last_breakdown: Optional[TimeBreakdown] = None
+
+# transfer-plane frame markers in profiler gap aggregates: any of
+# these dominating a gap segment says the untraced wall was the data
+# mover, not user compute
+TRANSFER_GAP_FRAMES: Tuple[str, ...] = (
+    "device_put", "block_until_ready", "remote_copy", "stage_view",
+    "put_array",
+)
+
+
+def publish_breakdown(bd: TimeBreakdown) -> None:
+    """Record ``bd`` as the process's latest attribution verdict."""
+    global _last_breakdown
+    _last_breakdown = bd
+
+
+def last_breakdown() -> Optional[TimeBreakdown]:
+    """The most recent published verdict (None before the first job)."""
+    return _last_breakdown
+
+
+def dma_wave_signal(bd: TimeBreakdown) -> Tuple[float, bool]:
+    """How loudly ``bd`` implicates the DMA-wave plane: the fraction
+    of wall attributed to ``dma-wave``, and whether the profiler's gap
+    frames point at the transfer path (``device_put`` and friends
+    dominating idle-untraced time). The wave self-tuner acts only when
+    one of the two says re-cutting waves can move the job."""
+    wall = bd.wall_ms or 1.0
+    fraction = bd.categories.get(DMA_WAVE, 0.0) / wall
+    transfer = any(
+        any(marker in frame for marker in TRANSFER_GAP_FRAMES)
+        for frame in bd.gap_frames
+    )
+    return fraction, transfer
+
+
 def attribute(path: CriticalPath, top_segments: int = 12) -> TimeBreakdown:
     """Fold a critical path into the category verdict."""
     cats: Dict[str, float] = {c: 0.0 for c in CATEGORIES}

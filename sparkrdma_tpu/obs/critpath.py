@@ -293,7 +293,7 @@ def job_breakdown(job_span, spans: Optional[Sequence] = None,
     into a :class:`~sparkrdma_tpu.obs.attr.TimeBreakdown`. Registers
     the ``critpath.*`` build metrics. ``spans`` defaults to every live
     tracer's spans (in-process cluster)."""
-    from sparkrdma_tpu.obs.attr import attribute
+    from sparkrdma_tpu.obs.attr import attribute, publish_breakdown
     from sparkrdma_tpu.obs.profiler import annotate_gaps
     from sparkrdma_tpu.obs.trace import collect_spans
 
@@ -306,6 +306,9 @@ def job_breakdown(job_span, spans: Optional[Sequence] = None,
     # folds segments into dicts (no-op without a live process profiler)
     annotate_gaps(path)
     verdict = attribute(path)
+    # feedback seam: attribution-driven controllers (the wave
+    # self-tuner, shuffle/autotune.py) read the latest verdict here
+    publish_breakdown(verdict)
     reg = get_registry()
     reg.counter("critpath.builds", role=role).inc()
     reg.histogram("critpath.build_ms", role=role).observe(

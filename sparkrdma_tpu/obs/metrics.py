@@ -61,6 +61,11 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "collective.lane_plans": ("counter", _L({"role"})),
     "collective.plan_ms": ("histogram", _L({"role"})),
     "collective.wave_ms": ("histogram", _L({"role", "schedule"})),
+    "collective.wave_dispatch_ms": ("histogram", _L({"role", "schedule"})),
+    "collective.wave_inflight": ("histogram", _L({"role"})),
+    "collective.wave_overlap_ms": ("counter", _L({"role"})),
+    "collective.autotune_adjustments": ("counter", _L({"role"})),
+    "collective.tuned_wave_bytes": ("gauge", _L({"role"})),
     # critical-path attribution (obs/critpath.py)
     "critpath.builds": ("counter", _L({"role"})),
     "critpath.build_ms": ("histogram", _L({"role"})),

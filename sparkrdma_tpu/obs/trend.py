@@ -23,6 +23,16 @@ it appeared in — or when a ledger row cannot be classified.  CI runs
 ``--check`` so a perf regression or a schema drift fails the build the same way
 a broken test does.
 
+Bench rounds are not all measured on the same machine, so absolute GB/s is
+only comparable when the rig is: when both rounds of a bench throughput
+series carry the rig probe (``exchange_loopback_gbps`` — a bare loopback
+``device_put`` with no shuffle code in it), the gate judges the
+roofline-NORMALIZED delta (series value / same-round probe).  A host that
+got slower moves every series and the probe together; that is a fact about
+the machine, not a code regression.  The probe series itself charts but
+never gates, for the same reason.  Rounds without the probe gate on raw
+deltas as before.
+
 Run as ``python -m sparkrdma_tpu.obs.trend``.
 """
 
@@ -94,6 +104,12 @@ _LEDGER_RE = re.compile(r"^(BENCH|WORKLOADS|SOAK)_r(\d+)\.json$")
 # "p99" (regression = rise — latency climbing is the failure mode).
 REGRESSION_THRESHOLD = 0.15
 NOISE_FLOOR_MIN = 0.05
+
+# The rig probe: a loopback device_put round-trip measured by the bench on
+# the machine it ran on.  No shuffle code is in its path, so per-round it
+# measures the RIG; bench throughput series gate on values normalized by it
+# when both rounds carry it, and the probe itself charts without gating.
+RIG_PROBE_SERIES = "bench.exchange_loopback_gbps"
 
 
 class LedgerError(ValueError):
@@ -250,12 +266,20 @@ def build_trend(root: str) -> Dict[str, Any]:
     # moved past it — and charts without gating (a drop between two historical
     # rounds is a fact, not an actionable regression).
     latest_round = {fam: max(rs) for fam, rs in rounds_by_family.items()}
+    probe_by_round = {
+        p["round"]: p["value"]
+        for p in trajectories.get(RIG_PROBE_SERIES, {}).get("points", [])
+        if p["value"] > 0
+    }
     regressions: List[Dict[str, Any]] = []
     for name, traj in trajectories.items():
         # Two tracked shapes: throughput rows (bench gbps series, regress DOWN)
         # and latency rows (soak/workloads p99 series, regress UP).  Both share
         # the same noise-floored gate threshold and stale-series exemption.
         if name.startswith("bench.") and "gbps" in name:
+            if name == RIG_PROBE_SERIES:
+                traj["rig_probe"] = True
+                continue
             direction = "down"
         elif name.startswith(("soak.", "workloads.")) and "p99" in name:
             direction = "up"
@@ -269,9 +293,21 @@ def build_trend(root: str) -> Dict[str, Any]:
         d = traj["rel_delta_latest"]
         if d is None:
             continue
+        pts = traj["points"]
+        normalized = False
+        if direction == "down":
+            # rig normalization: judge the roofline FRACTION when both
+            # rounds measured the probe on their own machine
+            p0 = probe_by_round.get(pts[-2]["round"])
+            p1 = probe_by_round.get(pts[-1]["round"])
+            if p0 and p1 and pts[-2]["value"]:
+                v0n = pts[-2]["value"] / p0
+                v1n = pts[-1]["value"] / p1
+                d = (v1n - v0n) / abs(v0n)
+                traj["rel_delta_normalized"] = d
+                normalized = True
         regressed = d < -gate_threshold if direction == "down" else d > gate_threshold
         if regressed:
-            pts = traj["points"]
             regressions.append(
                 {
                     "series": name,
@@ -281,6 +317,7 @@ def build_trend(root: str) -> Dict[str, Any]:
                     "round": pts[-1]["round"],
                     "value": pts[-1]["value"],
                     "rel_delta": d,
+                    "rig_normalized": normalized,
                 }
             )
 
@@ -308,7 +345,8 @@ def render_markdown(trend: Dict[str, Any]) -> str:
         + ", ".join(f"{fam} {rs}" for fam, rs in sorted(trend["rounds"].items())),
         f"- series: {trend['num_series']}, noise floor: {trend['noise_floor']:.1%},"
         f" gate threshold: ±{trend['gate_threshold']:.1%}"
-        " (gbps rows gate on drops, p99 rows gate on rises)",
+        " (gbps rows gate on drops, p99 rows gate on rises; bench gbps"
+        " gates rig-normalized when the loopback probe covers both rounds)",
         f"- regressions: {len(trend['regressions'])},"
         f" skipped rows: {len(trend['skipped'])}, errors: {len(trend['errors'])}",
         "",

@@ -234,15 +234,152 @@ def pallas_wave_pull(src_ids, stacked_sharded):
     return prog(src_ids, stacked_sharded)
 
 
+@functools.lru_cache(maxsize=1)
+def _same_device_copy_program():
+    """Jitted buffer copy for the same-device pull case: unlike
+    ``device_put`` (which may alias, see ``emulated_pull``) the jit
+    output is always a fresh buffer, and unlike the forced host round
+    trip it stays on-device AND dispatches asynchronously — the issue
+    half of the pipelined emulated mover. One jit object; XLA caches
+    one tiny executable per slab class."""
+    return jax.jit(jnp.copy)
+
+
+def emulated_row_pull_start(src_array, dst_device):
+    """START one row's pull without waiting — the emulated analogue of
+    ``make_async_remote_copy(...).start()``. Returns the in-flight
+    array; the wave's consume half waits on it (``emulated_wave_wait``)
+    before adopting. Same-device sources go through a jitted copy (an
+    independent buffer the source arena's later spill cannot delete);
+    cross-device sources ride the transfer engine."""
+    try:
+        src_devices = src_array.devices()
+    except Exception:
+        src_devices = set()
+    if dst_device in src_devices:
+        return _same_device_copy_program()(src_array)
+    return jax.device_put(src_array, dst_device)
+
+
+def emulated_wave_issue(stacked_host, dst_device):
+    """ISSUE an assembled [rows, bucket] stack toward the destination
+    without waiting: the transfer engine reads the host assembly while
+    the caller moves on to the next wave (or consumes the previous
+    one). The recv-semaphore wait lives in ``emulated_wave_wait``."""
+    return jax.device_put(stacked_host, dst_device)
+
+
+def emulated_wave_wait(inflight):
+    """Wait for issued transfers to land — the emulated recv-semaphore
+    wait. Accepts a single array or any pytree/list of them (one wave's
+    row pulls wait together, like the kernel's wait-all loop)."""
+    jax.block_until_ready(inflight)
+    return inflight
+
+
 def emulated_wave_pull(stacked_host, dst_device):
     """Off-TPU wave mover: land an assembled [rows, bucket] stack on
     the destination in ONE transfer-engine dispatch — the emulated
-    counterpart of one batched-DMA kernel epoch, and the reason the
-    compiled schedule beats per-block ``emulated_pull`` loops even on
-    the CPU mesh (one dispatch + one sync per wave, not per block)."""
-    pulled = jax.device_put(stacked_host, dst_device)
-    jax.block_until_ready(pulled)
-    return pulled
+    counterpart of one batched-DMA kernel epoch. Kept as the
+    issue+wait composition; the pipelined schedule compiler calls the
+    halves separately so wave N+1's issue overlaps wave N's merge."""
+    return emulated_wave_wait(emulated_wave_issue(stacked_host, dst_device))
+
+
+@functools.lru_cache(maxsize=64)
+def _pipelined_wave_pull_program(axis_size: int, depth: int, rows: int,
+                                 bucket_elems: int, dtype_str: str):
+    """Depth-aware double-buffered wave program: ``depth`` waves of
+    ``rows`` one-sided remote DMAs each, with wave d+1's DMAs STARTED
+    before wave d's wait loop runs — so the interconnect always has a
+    wave in flight while the previous one drains. One DMA-semaphore
+    array per in-flight wave (send and recv), exactly the per-lane
+    scratch shape of ``_wave_pull_program`` replicated per pipeline
+    slot, so wave d's waits never consume wave d+1's completions.
+
+    The caller groups consecutive same-(rows, bucket) waves up to the
+    ``collective.pipelineDepth`` knob; ragged neighbors fall back to
+    the single-wave program. Cached per (mesh size, depth, rows class,
+    bucket class, dtype) like every other wave executable."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from sparkrdma_tpu.utils.jax_compat import shard_map
+
+    dtype = jnp.dtype(dtype_str)
+
+    def kernel(src_ids, src_ref, dst_ref, *sems):
+        send_sems, recv_sems = sems[:depth], sems[depth:]
+
+        def _op(d, i):
+            return pltpu.make_async_remote_copy(
+                src_ref=src_ref.at[d, i],
+                dst_ref=dst_ref.at[d, i],
+                send_sem=send_sems[d].at[i],
+                recv_sem=recv_sems[d].at[i],
+                device_id=(src_ids[d, i],),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+
+        def start_wave(d):
+            jax.lax.fori_loop(
+                0, rows, lambda i, _: (_op(d, i).start(), _)[1], 0
+            )
+
+        def wait_wave(d):
+            jax.lax.fori_loop(
+                0, rows, lambda i, _: (_op(d, i).wait(), _)[1], 0
+            )
+
+        # the pipeline: wave d+1 is airborne before wave d drains, so
+        # the drain epoch of every wave but the last overlaps a wave's
+        # worth of in-flight DMA (depth is a Python constant — this
+        # unrolls at trace time)
+        start_wave(0)
+        for d in range(1, depth):
+            start_wave(d)
+            wait_wave(d - 1)
+        wait_wave(depth - 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=(
+            [pltpu.SemaphoreType.DMA((rows,))] * (2 * depth)
+        ),
+    )
+
+    pull = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((depth, rows, bucket_elems), dtype),
+        grid_spec=grid_spec,
+    )
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(jax.devices()[:axis_size], ("x",))
+    f = shard_map(
+        pull, mesh=mesh, in_specs=(P(), P("x")), out_specs=P("x"),
+        check_rep=False,
+    )
+    return jax.jit(f)
+
+
+def pallas_pipelined_wave_pull(src_ids, stacked_sharded, depth: int):
+    """Run ``depth`` same-class waves as one double-buffered kernel
+    epoch over a sharded [n*depth, rows, b] array; ``src_ids`` is the
+    [depth, rows] int32 source-device lane. TPU meshes only — the
+    schedule compiler gates on ``is_tpu_mesh()`` and uses the
+    emulated issue/wait halves otherwise."""
+    if not is_tpu_mesh():
+        raise RuntimeError("pallas_pipelined_wave_pull requires a TPU mesh")
+    n = mesh_device_count()
+    rows = stacked_sharded.shape[1]
+    prog = _pipelined_wave_pull_program(
+        n, depth, rows, stacked_sharded.shape[2], str(stacked_sharded.dtype)
+    )
+    return prog(src_ids, stacked_sharded)
 
 
 def pull_block(src_array, dst_device) -> Optional[object]:

@@ -1,4 +1,4 @@
-"""Whole-stage collective shuffle — the shuffle-schedule compiler.
+"""Whole-stage collective shuffle — the pipelined shuffle-schedule compiler.
 
 The device fetch plane (DESIGN.md §17) moves one block per planner
 decision: pin, pull, adopt, repeat. This module treats a reduce
@@ -9,17 +9,32 @@ mover dispatch — over a ring or all-to-all schedule, with compile-once
 programs cached by (rows-class, bucket-class, dtype) exactly like the
 exchange executable cache (DESIGN.md §22).
 
+Waves run as a double-buffered PIPELINE (``collective.pipelineDepth``
+in-flight entries): wave N+1's remote DMAs are dispatched while wave
+N's rows merge, so the drain epoch of every wave but the last overlaps
+a wave's worth of in-flight transfer. The host-plane passthrough reads
+overlap with both ends — issued before the first wave, drained
+concurrently with the last via the caller's ``drain`` callback.
+
 Movers, by regime:
 
 - TPU mesh: ``ops/remote_copy.pallas_wave_pull`` — one Pallas kernel
   epoch issuing ``rows`` ``make_async_remote_copy`` DMAs together
   (start all, wait all), per-row source device ids in a
   scalar-prefetch lane so one executable serves any peer set.
-- Everywhere else (and on any TPU-side surprise): an assembled host
-  stack lands on the destination in ONE transfer-engine dispatch
-  (``emulated_wave_pull``) — still one dispatch + one sync per wave
-  instead of per block, which is why the compiled schedule beats the
-  per-block pull loop even on the CPU mesh.
+  Consecutive same-class waves coalesce into the depth-aware
+  ``pallas_pipelined_wave_pull`` program — one DMA-semaphore array per
+  in-flight wave, wave d+1 started before wave d drains.
+- Everywhere else (and on any TPU-side surprise): the emulated mover's
+  ISSUE/CONSUME halves (``emulated_row_pull_start`` /
+  ``emulated_wave_wait``) — per-row pulls started together without
+  waiting, landed slabs adopted directly (the same single-copy
+  semantics as the per-block planner, batched, async, and overlapped
+  across waves), which is why the compiled schedule beats the
+  per-block pull loop even on the CPU mesh. Rows the fast lane cannot
+  carry (nonzero arena offset, class mismatch, fused partitions that
+  merge host-side) ride an assembled host stack and land through the
+  compile-free ``stage_view`` path.
 
 Fusion: a partition whose every block rides in one wave can merge in
 the same epoch — a cached compaction program gathers the wave's valid
@@ -29,6 +44,12 @@ composing with the merged-cover contract of shuffle/merge.py) with no
 intermediate HBM round trip. Fusion changes the result SHAPE (one
 buffer per partition), so callers opt in per fetch.
 
+Self-tuning: the compiler's :class:`~sparkrdma_tpu.shuffle.autotune.
+WaveAutoTuner` re-derives the effective ``collective.waveBytes`` per
+(shuffle, stage-shape) signature from the stage's own wave stats plus
+the job's TimeBreakdown and profiler gap frames — the second identical
+stage of a job already runs with the adjusted cut.
+
 Degrade ladder (every rung silent, byte-identical):
 
 | condition                                   | outcome             |
@@ -37,7 +58,9 @@ Degrade ladder (every rung silent, byte-identical):
 | < ``collective.minBlocks`` device blocks     | per-block planner   |
 | block fails eligibility (size/dtype/arena)   | per-block planner   |
 | slab evicted/spilled between plan and pin    | host triple, degrade++ |
-| wave mover fails                             | host triple, degrade++ |
+| wave mover fails (issue OR landing)          | host triple, degrade++ |
+| row adoption fails mid-pipeline              | host triple, degrade++ |
+| abort unwinds with waves in flight           | pins closed, rows degrade |
 """
 
 from __future__ import annotations
@@ -45,8 +68,9 @@ from __future__ import annotations
 import functools
 import logging
 import time
+from collections import deque
 from contextlib import ExitStack
-from typing import Dict, List, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -55,7 +79,16 @@ from sparkrdma_tpu.locations import PartitionLocation
 from sparkrdma_tpu.obs import get_registry
 from sparkrdma_tpu.ops import remote_copy
 from sparkrdma_tpu.ops.exchange import round_bucket, round_rows
-from sparkrdma_tpu.ops.hbm_arena import DeviceBuffer, DeviceBufferManager
+from sparkrdma_tpu.ops.hbm_arena import (
+    DeviceBuffer,
+    DeviceBufferManager,
+    _size_class,
+)
+from sparkrdma_tpu.shuffle.autotune import (
+    WaveAutoTuner,
+    WaveReport,
+    stage_signature,
+)
 from sparkrdma_tpu.shuffle.device_fetch import visible_arena
 
 logger = logging.getLogger(__name__)
@@ -131,19 +164,27 @@ class CollectivePlan:
     ``passthrough`` locations never entered the schedule (collective
     off, too few device blocks, or per-block ineligibility) — the
     caller runs them through the pre-existing per-block loop, which
-    preserves exactly the old behavior when the compiler declines."""
+    preserves exactly the old behavior when the compiler declines.
+
+    ``sig``/``stage_bytes``/``max_group_bytes`` feed the wave
+    self-tuner after execution (None/0 when the compiler declined)."""
 
     __slots__ = ("schedule", "waves", "passthrough", "fusable_pids",
-                 "device_blocks")
+                 "device_blocks", "sig", "stage_bytes", "max_group_bytes")
 
     def __init__(self, schedule: str, waves: List[CollectiveWave],
                  passthrough: List[PartitionLocation],
-                 fusable_pids: frozenset, device_blocks: int):
+                 fusable_pids: frozenset, device_blocks: int,
+                 sig: Optional[Tuple] = None, stage_bytes: int = 0,
+                 max_group_bytes: int = 0):
         self.schedule = schedule
         self.waves = waves
         self.passthrough = passthrough
         self.fusable_pids = fusable_pids
         self.device_blocks = device_blocks
+        self.sig = sig
+        self.stage_bytes = stage_bytes
+        self.max_group_bytes = max_group_bytes
 
 
 class CollectiveResult:
@@ -161,6 +202,44 @@ class CollectiveResult:
         self.fused = fused
 
 
+class _InflightWave:
+    """One pipeline entry: a wave (or a same-class TPU kernel run of
+    them) whose transfers are airborne. Pins stay held from issue to
+    consume — the source slabs must survive until the recv semaphores
+    land; the pipeline bounds the held set to ``depth`` entries."""
+
+    __slots__ = ("waves", "pins", "t0", "dead", "all_dead", "row_arrs",
+                 "row_views", "stacked_hosts", "landed", "nbytes", "live")
+
+    def __init__(self, waves: List[CollectiveWave], pins: ExitStack,
+                 t0: float):
+        self.waves = waves
+        self.pins = pins
+        self.t0 = t0
+        self.dead: List[_Row] = []
+        self.all_dead = False
+        # per wave: fast-lane in-flight arrays (row index -> array)
+        self.row_arrs: List[Dict[int, object]] = []
+        # per wave: zero-copy host views of pinned sources (fused CPU
+        # rows — the merge concatenates straight from these, skipping
+        # the stacked-assembly copy; valid only while pins are held)
+        self.row_views: List[Dict[int, np.ndarray]] = []
+        # per wave: assembled host stack (None when every row rode the
+        # fast lane or a view)
+        self.stacked_hosts: List[Optional[np.ndarray]] = []
+        # TPU/fallback in-flight device object: ("single"|"pipelined",
+        # async sharded result) or ("emulated", [stacks])
+        self.landed = None
+        self.nbytes = 0
+        self.live = 0
+
+    def close(self) -> None:
+        try:
+            self.pins.close()
+        except Exception:
+            logger.exception("collective pin release failed")
+
+
 class ShuffleScheduleCompiler:
     """Compile + execute whole-stage device fetch schedules."""
 
@@ -174,6 +253,7 @@ class ShuffleScheduleCompiler:
         # this counts resolutions for the compile-churn metrics)
         self._seen_programs: set = set()
         self._cache_lock = named_lock("collective.compiler")
+        self._tuner = WaveAutoTuner(conf, executor_id)
         reg = get_registry()
         role = executor_id
         self._m_plans = reg.counter("collective.plans", role=role)
@@ -184,6 +264,12 @@ class ShuffleScheduleCompiler:
         self._m_compiles = reg.counter("collective.compiles", role=role)
         self._m_cache_hits = reg.counter("collective.cache_hits", role=role)
         self._m_plan_ms = reg.histogram("collective.plan_ms", role=role)
+        self._m_overlap = reg.counter(
+            "collective.wave_overlap_ms", role=role
+        )
+        self._m_inflight = reg.histogram(
+            "collective.wave_inflight", role=role
+        )
         # the device-fetch plane's counters stay the one source of truth
         # for "blocks that moved HBM->HBM" vs "device offers declined":
         # a landed wave row IS a device pull, a degraded row IS a
@@ -245,20 +331,39 @@ class ShuffleScheduleCompiler:
         # contiguous, source-ordered within the partition
         eligible.sort(key=lambda loc: (loc.partition_id, merge_order_key(loc)))
         per_pid_eligible: Dict[int, int] = {}
+        per_pid_bytes: Dict[int, int] = {}
+        stage_bytes = 0
+        max_len = 0
         for loc in eligible:
-            per_pid_eligible[loc.partition_id] = (
-                per_pid_eligible.get(loc.partition_id, 0) + 1
-            )
+            pid = loc.partition_id
+            per_pid_eligible[pid] = per_pid_eligible.get(pid, 0) + 1
+            bucketed = round_bucket(loc.block.length)
+            per_pid_bytes[pid] = per_pid_bytes.get(pid, 0) + bucketed
+            stage_bytes += bucketed
+            max_len = max(max_len, loc.block.length)
+        max_group_bytes = max(per_pid_bytes.values())
 
         lanes = sorted({loc.manager_id.executor_id for loc in eligible})
         schedule = conf.collective_schedule
         if schedule == "auto":
             schedule = "a2a" if len(lanes) > 2 else "ring"
 
+        # the self-tuned cut: a stage shape the tuner has observed runs
+        # with its adjusted budget (never below the fusion floor — a
+        # partition's rows must share one wave — and never above the
+        # operator's configured cap)
+        sig = stage_signature(
+            schedule, len(lanes), round_rows(len(eligible)),
+            round_bucket(max_len), np.dtype(dtype).name,
+        )
+        wave_budget = conf.collective_wave_bytes
+        tuned = self._tuner.wave_bytes_for(sig)
+        if tuned:
+            wave_budget = min(max(tuned, max_group_bytes), wave_budget)
+
         # wave formation: pid-group granularity (fusion needs a pid's
         # rows in ONE wave), split only when a single pid alone
         # overflows the wave budget (that pid becomes unfusable)
-        wave_budget = conf.collective_wave_bytes
         waves: List[CollectiveWave] = []
         fusable: set = set()
         cur_rows: List[_Row] = []
@@ -279,13 +384,12 @@ class ShuffleScheduleCompiler:
         while i < n:
             pid = eligible[i].partition_id
             j = i
-            group_bytes = 0
             group_max = 0
             while j < n and eligible[j].partition_id == pid:
-                group_bytes += round_bucket(eligible[j].block.length)
                 group_max = max(group_max, eligible[j].block.length)
                 j += 1
             group = eligible[i:j]
+            group_bytes = per_pid_bytes[pid]
             if group_bytes > wave_budget and len(group) > 1:
                 # oversized pid: seal what we have, stream the pid
                 # through dedicated waves, leave it unfusable
@@ -317,11 +421,17 @@ class ShuffleScheduleCompiler:
 
         if schedule == "ring":
             # lane-major wave order: one source lane in flight at a
-            # time, walking the ring — the flow-controlled schedule
-            waves.sort(key=lambda w: lanes.index(w.lane))
+            # time, walking the ring — the flow-controlled schedule.
+            # Index lookups go through a precomputed map: the linear
+            # lanes.index() scan inside a sort key is O(waves * lanes)
+            # work a wide stage pays on every plan
+            lane_index = {lane: k for k, lane in enumerate(lanes)}
+            waves.sort(key=lambda w: lane_index[w.lane])
         self._m_plan_ms.observe((time.perf_counter() - t0) * 1e3)
         return CollectivePlan(
-            schedule, waves, passthrough, frozenset(fusable), len(eligible)
+            schedule, waves, passthrough, frozenset(fusable), len(eligible),
+            sig=sig, stage_bytes=stage_bytes,
+            max_group_bytes=max_group_bytes,
         )
 
     # ------------------------------------------------------------------
@@ -333,27 +443,43 @@ class ShuffleScheduleCompiler:
         plan: CollectivePlan,
         dtype=np.uint8,
         fused: bool = False,
+        drain=None,
     ) -> Tuple[List[CollectiveResult], List[PartitionLocation]]:
-        """Run the compiled schedule; returns ``(results, degraded)``.
+        """Run the compiled schedule as a double-buffered pipeline;
+        returns ``(results, degraded)``.
+
+        Up to ``collective.pipelineDepth`` entries stay in flight:
+        entry N+1's transfers are DISPATCHED before entry N's rows are
+        waited on and adopted, so merge epochs overlap in-flight DMA.
+        ``drain``, when given, is called with no arguments between
+        pipeline steps — the host-plane caller passes its non-blocking
+        arrivals drain so passthrough READs are consumed WHILE waves
+        are in flight rather than after the last one.
 
         ``degraded`` lists every scheduled block that missed (evicted
-        mid-stage, stale coordinates, mover failure) — the caller host-
-        fetches them; with fusion on, a miss also unfuses its partition
-        (the survivors land per block, the host fills the gap), so the
-        byte content of the stage is identical on every path."""
+        mid-stage, stale coordinates, mover failure, adoption failure)
+        — the caller host-fetches them; with fusion on, a miss also
+        unfuses its partition (the survivors land per block, the host
+        fills the gap), so the byte content of the stage is identical
+        on every path. Per-entry failures never raise; if an exception
+        DOES unwind (e.g. out of ``drain``), every in-flight entry's
+        pins are closed on the way out — no slab or pin outlives the
+        stage."""
         if not plan.waves:
             return [], []
         fused = bool(fused) and self._conf.collective_fused_merge
+        depth = max(1, self._conf.collective_pipeline_depth)
         self._schedule_label = plan.schedule
         reg = get_registry()
         results: List[CollectiveResult] = []
         degraded: List[PartitionLocation] = []
         self._m_plans.inc()
+        stats = {"dispatch_ms": 0.0, "wave_ms": 0.0, "overlap_ms": 0.0}
         span = (
             self._tracer.span(
                 "shuffle.collective", shuffle_id=shuffle_id,
                 schedule=plan.schedule, waves=len(plan.waves),
-                blocks=plan.device_blocks,
+                blocks=plan.device_blocks, depth=depth,
             )
             if self._tracer is not None
             else None
@@ -363,32 +489,77 @@ class ShuffleScheduleCompiler:
             # pids that lose a row to degradation must not fuse: the
             # host path refills per block, so survivors stay per block
             unfusable: set = set()
-            landed: List[Tuple[CollectiveWave, object, List[int], object]] = []
-            for wave in plan.waves:
-                out = self._run_wave(shuffle_id, wave, dtype, reg)
-                if out is None:
-                    # whole-wave mover failure: every row degrades
-                    for row in wave.rows:
-                        degraded.append(row.loc)
-                        unfusable.add(row.loc.partition_id)
-                    self._m_degrades.inc(len(wave.rows))
-                    self._m_plane_fallbacks.inc(len(wave.rows))
-                    continue
-                stacked_dev, dead, stacked_host = out
-                for i in dead:
-                    degraded.append(wave.rows[i].loc)
-                    unfusable.add(wave.rows[i].loc.partition_id)
-                if dead:
-                    self._m_degrades.inc(len(dead))
-                    self._m_plane_fallbacks.inc(len(dead))
-                landed.append((wave, stacked_dev, dead, stacked_host))
+            inflight: Deque[_InflightWave] = deque()
 
-            for wave, stacked_dev, dead, stacked_host in landed:
-                results.extend(self._adopt_wave(
-                    wave, stacked_dev, dtype,
-                    fused, plan.fusable_pids - unfusable,
-                    stacked_host=stacked_host,
+            def _degrade_rows(rows: List[_Row]) -> None:
+                if not rows:
+                    return
+                for row in rows:
+                    degraded.append(row.loc)
+                    unfusable.add(row.loc.partition_id)
+                self._m_degrades.inc(len(rows))
+                self._m_plane_fallbacks.inc(len(rows))
+
+            def _consume_next() -> None:
+                entry = inflight.popleft()
+                self._consume_entry(
+                    entry, shuffle_id, dtype, fused, plan.fusable_pids,
+                    unfusable, results, _degrade_rows, reg,
+                    overlapped=bool(inflight), stats=stats,
+                )
+                if drain is not None:
+                    drain()
+
+            try:
+                for group in self._coalesce(plan.waves, depth):
+                    while len(inflight) >= depth:
+                        _consume_next()
+                    entry = self._issue_entry(
+                        shuffle_id, group, dtype, fused,
+                        plan.fusable_pids, reg,
+                        overlapped=bool(inflight), stats=stats,
+                    )
+                    if entry is None:
+                        # whole-entry mover failure: every row degrades
+                        _degrade_rows(
+                            [r for w in group for r in w.rows]
+                        )
+                        continue
+                    _degrade_rows(entry.dead)
+                    if entry.all_dead:
+                        continue
+                    inflight.append(entry)
+                    self._m_inflight.observe(float(len(inflight)))
+                    if drain is not None:
+                        drain()
+                while inflight:
+                    _consume_next()
+            finally:
+                # abort drain (an exception is unwinding): release every
+                # in-flight entry's pins and degrade its unadopted rows
+                # — leak-free by construction, and the caller's host
+                # refill keeps the stage byte-identical when it survives
+                while inflight:
+                    entry = inflight.popleft()
+                    entry.close()
+                    _degrade_rows(
+                        [r for w in entry.waves for r in w.rows if r.live]
+                    )
+        # close the loop: feed the stage's wave stats back into the
+        # per-shape cut for the NEXT identical stage
+        if plan.sig is not None:
+            try:
+                self._tuner.observe(plan.sig, WaveReport(
+                    stage_bytes=plan.stage_bytes,
+                    min_group_bytes=plan.max_group_bytes,
+                    waves=len(plan.waves),
+                    depth=depth,
+                    dispatch_ms=stats["dispatch_ms"],
+                    wave_ms=stats["wave_ms"],
+                    overlap_ms=stats["overlap_ms"],
                 ))
+            except Exception:
+                logger.exception("wave autotune observe failed")
         return results, degraded
 
     # ------------------------------------------------------------------
@@ -400,20 +571,55 @@ class ShuffleScheduleCompiler:
                 self._seen_programs.add(key)
                 self._m_compiles.inc()
 
-    def _run_wave(self, shuffle_id, wave: CollectiveWave, dtype, reg):
-        """Pin, assemble, and move one wave. Returns ``(stacked_dev,
-        dead_row_indices, stacked_host)`` or None on a whole-wave
-        mover failure; ``stacked_host`` is the host-side assembly the
-        emulated mover staged from (adoption compacts it with plain
-        numpy instead of the device gather when off TPU)."""
+    def _coalesce(
+        self, waves: List[CollectiveWave], depth: int
+    ) -> List[List[CollectiveWave]]:
+        """Group consecutive same-class waves into depth-aware kernel
+        runs. TPU only: the run becomes ONE ``pallas_pipelined_wave_
+        pull`` epoch with a DMA-semaphore array per in-flight wave. Off
+        TPU every wave is its own pipeline entry — the overlap happens
+        at the host level (issue N+1 while N merges)."""
+        if depth <= 1 or not remote_copy.is_tpu_mesh():
+            return [[w] for w in waves]
+        groups: List[List[CollectiveWave]] = []
+        i = 0
+        while i < len(waves):
+            j = i + 1
+            while (
+                j < len(waves)
+                and j - i < depth
+                and waves[j].rows_b == waves[i].rows_b
+                and waves[j].bucket_elems == waves[i].bucket_elems
+            ):
+                j += 1
+            groups.append(list(waves[i:j]))
+            i = j
+        return groups
+
+    def _issue_entry(
+        self, shuffle_id: int, waves: List[CollectiveWave], dtype,
+        fused: bool, fusable_pids: frozenset, reg, overlapped: bool,
+        stats: Dict[str, float],
+    ) -> Optional[_InflightWave]:
+        """Pin, assemble, and DISPATCH one pipeline entry without
+        waiting — the issue half of the double buffer. Rows that fail
+        the under-pin residency re-check come back in ``entry.dead``
+        (the caller degrades them); a mover surprise returns None and
+        the whole entry degrades. The entry's pins stay held until its
+        consume: the source slabs must outlive the in-flight DMAs."""
         t0 = time.perf_counter()
         itemsize = np.dtype(dtype).itemsize
-        rows_b = wave.rows_b
-        b_elems = wave.bucket_elems
-        stacked = np.zeros((rows_b, b_elems), dtype=dtype)
-        dead: List[int] = []
+        tpu = remote_copy.is_tpu_mesh()
+        pins = ExitStack()
+        entry = _InflightWave(waves, pins, t0)
         try:
-            with ExitStack() as pins:
+            for wave in waves:
+                rows_b, b_elems = wave.rows_b, wave.bucket_elems
+                stacked: Optional[np.ndarray] = (
+                    np.zeros((rows_b, b_elems), dtype=dtype) if tpu else None
+                )
+                arrs: Dict[int, object] = {}
+                views: Dict[int, np.ndarray] = {}
                 for i, row in enumerate(wave.rows):
                     blk = row.loc.block
                     arena = visible_arena(row.loc.manager_id.executor_id)
@@ -428,103 +634,261 @@ class ShuffleScheduleCompiler:
                         or np.dtype(src.array.dtype) != np.dtype(dtype)
                     ):
                         row.live = False
-                        dead.append(i)
+                        entry.dead.append(row)
                         continue
-                    # the emulated gather: source HBM -> host lane of
-                    # the assembled stack (the TPU path skips this and
-                    # DMAs source-side shards directly)
+                    fuse_row = fused and row.loc.partition_id in fusable_pids
+                    if (
+                        not tpu
+                        and not fuse_row
+                        and blk.arena_offset == 0
+                        and src.array.nbytes == _size_class(blk.length)
+                    ):
+                        # fast lane: START the row's pull now (async;
+                        # same-device sources go through a jitted copy,
+                        # cross-device through the transfer engine) and
+                        # adopt the landed slab whole at consume — the
+                        # per-block planner's single-copy semantics,
+                        # batched and overlapped
+                        arrs[i] = remote_copy.emulated_row_pull_start(
+                            src.array, self._dev.device
+                        )
+                        continue
                     host = np.asarray(src.array).view(dtype)
                     off = blk.arena_offset // itemsize
+                    if not tpu and fuse_row:
+                        # fused CPU row: hold a zero-copy view of the
+                        # pinned source — the merge at consume
+                        # concatenates straight from it, skipping the
+                        # stacked-assembly copy (the pin stays held
+                        # through adoption, so the view stays valid)
+                        views[i] = host[off : off + row.elems]
+                        continue
+                    # the emulated gather: source HBM -> host lane of
+                    # the assembled stack (the TPU path DMAs
+                    # source-side shards instead; off TPU this lane
+                    # carries offset/class-mismatched rows)
+                    if stacked is None:
+                        stacked = np.zeros((rows_b, b_elems), dtype=dtype)
                     stacked[i, : row.elems] = host[off : off + row.elems]
-            if len(dead) == len(wave.rows):
+                entry.row_arrs.append(arrs)
+                entry.row_views.append(views)
+                entry.stacked_hosts.append(stacked)
+            live_rows = [r for w in waves for r in w.rows if r.live]
+            if not live_rows:
                 # every row died at the pin: nothing to move; the
-                # caller degrades them all (tuple keeps the uniform
-                # "landed" return shape, distinct from mover failure)
-                return None, dead, None
-            key = ("wave", rows_b, b_elems, np.dtype(dtype).name)
-            self._program_key_seen(key)
-            stacked_dev = None
-            if remote_copy.is_tpu_mesh():
-                # batched-DMA kernel epoch: one compiled program per
-                # (rows class, bucket class, dtype), per-row source ids
-                # in the scalar-prefetch lane. Any bring-up surprise
-                # degrades to the transfer engine below — same bytes.
-                try:
-                    stacked_dev = self._pallas_wave(wave, stacked)
-                except Exception:
-                    logger.exception(
-                        "pallas wave mover failed; using transfer engine"
-                    )
-            if stacked_dev is None:
-                stacked_dev = remote_copy.emulated_wave_pull(
-                    stacked, self._dev.device
-                )
+                # caller degrades them all
+                pins.close()
+                entry.all_dead = True
+                return entry
+            if tpu:
+                entry.landed = self._dispatch_pallas(waves, entry, dtype)
+            if len(waves) > 1:
+                key = ("wave-pipe", len(waves), waves[0].rows_b,
+                       waves[0].bucket_elems, np.dtype(dtype).name)
+                self._program_key_seen(key)
+            else:
+                for wave in waves:
+                    key = ("wave", wave.rows_b, wave.bucket_elems,
+                           np.dtype(dtype).name)
+                    self._program_key_seen(key)
         except Exception:
-            logger.exception("collective wave failed; degrading to host")
+            logger.exception("collective wave issue failed; degrading to host")
+            pins.close()
             return None
-        live = len(wave.rows) - len(dead)
-        nbytes = sum(r.elems * itemsize for r in wave.rows if r.live)
-        self._m_blocks.inc(live)
-        self._m_bytes.inc(nbytes)
-        self._m_plane_pulls.inc(live)
-        self._m_plane_bytes.inc(nbytes)
-        reg.counter(
-            "collective.waves", role=self._executor_id,
-            schedule=self._schedule_label,
-        ).inc()
+        entry.live = len(live_rows)
+        entry.nbytes = sum(r.elems * itemsize for r in live_rows)
+        dispatch_ms = (time.perf_counter() - t0) * 1e3
         reg.histogram(
-            "collective.wave_ms", role=self._executor_id,
+            "collective.wave_dispatch_ms", role=self._executor_id,
             schedule=self._schedule_label,
-        ).observe((time.perf_counter() - t0) * 1e3)
-        if self._tracer is not None:
-            # per-wave span (dma-wave attribution, obs/attr.py): nests
-            # under execute()'s shuffle.collective span via the
-            # contextvar parent, so the critical path can enter the
-            # wave level instead of one opaque multi-wave slice
-            self._tracer.record(
-                "shuffle.collective.wave",
-                t0,
-                time.perf_counter(),
-                shuffle_id=shuffle_id,
-                rows=live,
-                bytes=nbytes,
+        ).observe(dispatch_ms)
+        stats["dispatch_ms"] += dispatch_ms
+        if overlapped:
+            # this dispatch ran while earlier waves were still in
+            # flight — the pipeline's whole point, surfaced as a
+            # counter the benches assert on
+            stats["overlap_ms"] += dispatch_ms
+            self._m_overlap.inc(dispatch_ms)
+        return entry
+
+    def _dispatch_pallas(self, waves: List[CollectiveWave],
+                         entry: _InflightWave, dtype):
+        """START the entry's remote DMAs as one kernel epoch (the
+        depth-aware double-buffered program when the entry carries a
+        same-class run) WITHOUT waiting; consume slices the landed
+        result per wave. The send-layout shards carry the waves on
+        every source device; the per-row id lane names which peer's
+        DMA lands each row. Any bring-up surprise falls back to the
+        transfer engine — same bytes."""
+        import jax
+
+        n = remote_copy.mesh_device_count()
+        try:
+            if len(waves) == 1:
+                wave = waves[0]
+                ids = np.zeros((wave.rows_b,), dtype=np.int32)
+                for i, row in enumerate(wave.rows):
+                    ids[i] = max(0, row.loc.block.device_coords) % n
+                sharded = jax.device_put(
+                    np.tile(entry.stacked_hosts[0], (n, 1))
+                )
+                return ("single", remote_copy.pallas_wave_pull(ids, sharded))
+            depth = len(waves)
+            rows_b = waves[0].rows_b
+            b_elems = waves[0].bucket_elems
+            ids = np.zeros((depth, rows_b), dtype=np.int32)
+            stack = np.zeros((depth, rows_b, b_elems), dtype=dtype)
+            for d, wave in enumerate(waves):
+                stack[d] = entry.stacked_hosts[d]
+                for i, row in enumerate(wave.rows):
+                    ids[d, i] = max(0, row.loc.block.device_coords) % n
+            sharded = jax.device_put(np.tile(stack, (n, 1, 1)))
+            return (
+                "pipelined",
+                remote_copy.pallas_pipelined_wave_pull(ids, sharded, depth),
             )
-        return stacked_dev, dead, stacked
+        except Exception:
+            logger.exception("pallas wave mover failed; using transfer engine")
+            return ("emulated", [
+                remote_copy.emulated_wave_issue(
+                    entry.stacked_hosts[d], self._dev.device
+                )
+                for d in range(len(waves))
+            ])
+
+    def _consume_entry(
+        self, entry: _InflightWave, shuffle_id: int, dtype, fused: bool,
+        fusable_pids: frozenset, unfusable: set, results, _degrade_rows,
+        reg, overlapped: bool, stats: Dict[str, float],
+    ) -> None:
+        """Wait for one entry's transfers (the recv-semaphore wait),
+        release its pins, and adopt its rows into arena slabs. Never
+        raises: a landing failure degrades the entry, an adoption
+        failure degrades the affected rows — the pipeline keeps
+        flowing either way."""
+        t0 = time.perf_counter()
+        role = self._executor_id
+        try:
+            waiting: List[object] = [
+                a for arrs in entry.row_arrs for a in arrs.values()
+            ]
+            if entry.landed is not None:
+                _, obj = entry.landed
+                waiting.extend(obj if isinstance(obj, list) else [obj])
+            remote_copy.emulated_wave_wait(waiting)
+            stacked_devs = self._landed_stacks(entry)
+        except Exception:
+            logger.exception(
+                "collective wave landing failed; degrading to host"
+            )
+            entry.close()
+            _degrade_rows(
+                [r for w in entry.waves for r in w.rows if r.live]
+            )
+            return
+        # pins stay held through adoption: the fused merge reads
+        # zero-copy views of the source slabs (the finally releases
+        # them even if an adopt body throws)
+        itemsize = np.dtype(dtype).itemsize
+        now = time.perf_counter()
+        try:
+            for d, wave in enumerate(entry.waves):
+                live = [r for r in wave.rows if r.live]
+                if not live:
+                    continue
+                nbytes = sum(r.elems * itemsize for r in live)
+                self._m_blocks.inc(len(live))
+                self._m_bytes.inc(nbytes)
+                self._m_plane_pulls.inc(len(live))
+                self._m_plane_bytes.inc(nbytes)
+                reg.counter(
+                    "collective.waves", role=role,
+                    schedule=self._schedule_label,
+                ).inc()
+                out, failed = self._adopt_wave(
+                    wave,
+                    stacked_devs[d] if stacked_devs is not None else None,
+                    dtype, fused, fusable_pids - unfusable,
+                    stacked_host=entry.stacked_hosts[d],
+                    row_arrs=entry.row_arrs[d],
+                    row_views=entry.row_views[d],
+                )
+                results.extend(out)
+                _degrade_rows(failed)
+                reg.histogram(
+                    "collective.wave_ms", role=role,
+                    schedule=self._schedule_label,
+                ).observe((now - entry.t0) * 1e3)
+                stats["wave_ms"] += (now - entry.t0) * 1e3
+                if self._tracer is not None:
+                    # per-wave span (dma-wave attribution, obs/attr.py):
+                    # nests under execute()'s shuffle.collective span
+                    # via the contextvar parent, so the critical path
+                    # can enter the wave level instead of one opaque
+                    # multi-wave slice
+                    self._tracer.record(
+                        "shuffle.collective.wave",
+                        entry.t0,
+                        time.perf_counter(),
+                        shuffle_id=shuffle_id,
+                        rows=len(live),
+                        bytes=nbytes,
+                    )
+        finally:
+            entry.close()
+        consume_ms = (time.perf_counter() - t0) * 1e3
+        if overlapped:
+            # this merge ran with later waves' DMAs already airborne
+            stats["overlap_ms"] += consume_ms
+            self._m_overlap.inc(consume_ms)
 
     # conf-resolved schedule of the plan currently executing (execute()
     # runs plans one at a time per endpoint; set before the wave loop)
     _schedule_label = "ring"
 
-    def _pallas_wave(self, wave: CollectiveWave, stacked: np.ndarray):
-        """TPU mover: run the wave as one batched remote-DMA kernel
-        epoch (``ops/remote_copy._wave_pull_program``). The send-layout
-        shards carry the wave on every source device; the per-row id
-        lane names which peer's DMA lands each row. Returns the landed
-        [rows_b, bucket] stack committed to the local device, or raises
-        (caller falls back to the transfer engine)."""
+    def _landed_stacks(self, entry: _InflightWave):
+        """Per-wave landed device stacks for the TPU/fallback paths
+        (None on the pure emulated path, whose rows adopt from the
+        fast-lane arrays and the host assembly directly)."""
+        if entry.landed is None:
+            return None
         import jax
 
-        n = remote_copy.mesh_device_count()
-        rows_b = wave.rows_b
-        ids = np.zeros((rows_b,), dtype=np.int32)
-        for i, row in enumerate(wave.rows):
-            ids[i] = max(0, row.loc.block.device_coords) % n
-        sharded = jax.device_put(np.tile(stacked, (n, 1)))
-        landed = remote_copy.pallas_wave_pull(ids, sharded)
-        return jax.device_put(
-            np.asarray(landed)[:rows_b], self._dev.device
-        )
+        kind, obj = entry.landed
+        if kind == "emulated":
+            return obj
+        if kind == "single":
+            wave = entry.waves[0]
+            arr = np.asarray(obj)[: wave.rows_b]
+            return [jax.device_put(arr, self._dev.device)]
+        arr = np.asarray(obj)[: len(entry.waves)]
+        return [
+            jax.device_put(arr[d], self._dev.device)
+            for d in range(len(entry.waves))
+        ]
 
     def _adopt_wave(self, wave, stacked_dev, dtype, fused, fusable_pids,
-                    stacked_host=None):
-        """Slice a landed wave into arena slabs: fused partitions land
-        as one merged slab; everything else lands per block. Fused
-        compaction runs the cached device gather when the wave is TPU-
-        resident, and a plain numpy concatenate off-TPU (the emulated
-        mover assembled ``stacked_host`` anyway, and a device gather
-        program is pure overhead on the single-core harness)."""
+                    stacked_host=None, row_arrs=None, row_views=None):
+        """Adopt a landed wave into arena slabs: fused partitions land
+        as one merged slab; everything else lands per block. Returns
+        ``(results, failed_rows)`` — adoption failures degrade their
+        rows instead of unwinding the pipeline.
+
+        Row sources, one merge order: fast-lane rows adopt their
+        landed slab-class array whole (``put_array``, no pad program —
+        classes match by construction); fused CPU rows concatenate
+        from zero-copy views of the still-pinned sources (one copy,
+        not assembly + copy); assembled rows stage their exact payload
+        through the compile-free ``stage_view`` path; TPU rows slice
+        the landed device stack. Fused compaction runs the cached
+        device gather when the wave is TPU-resident, and a plain numpy
+        concatenate off-TPU (a device gather program is pure overhead
+        on the single-core harness)."""
         itemsize = np.dtype(dtype).itemsize
+        row_arrs = row_arrs or {}
+        row_views = row_views or {}
         out: List[CollectiveResult] = []
+        failed: List[_Row] = []
         flat = None
         starts_e = None
         if fused:
@@ -541,15 +905,16 @@ class ShuffleScheduleCompiler:
                 r.live and r.loc.partition_id in fusable_pids
                 for r in wave.rows
             )
-            if need and stacked_host is not None and (
-                not remote_copy.is_tpu_mesh()
+            if need and not remote_copy.is_tpu_mesh() and (
+                row_views or stacked_host is not None
             ):
                 flat = np.concatenate(
-                    [stacked_host[i, : r.elems]
+                    [row_views[i] if i in row_views
+                     else stacked_host[i, : r.elems]
                      for i, r in enumerate(wave.rows) if r.live]
                     or [np.empty(0, dtype=dtype)]
                 )
-            elif need:
+            elif need and stacked_dev is not None:
                 key = ("compact", wave.rows_b, wave.bucket_elems,
                        np.dtype(dtype).name)
                 self._program_key_seen(key)
@@ -570,39 +935,76 @@ class ShuffleScheduleCompiler:
             if not group:
                 i = j
                 continue
-            if fused and flat is not None and pid in fusable_pids:
-                lo = int(starts_e[i])
-                hi = lo + sum(r.elems for r in group)
-                seg = flat[lo:hi]
-                if isinstance(seg, np.ndarray):
-                    # host-compacted: the merged slab moves in ONE put
-                    import jax
+            try:
+                if fused and flat is not None and pid in fusable_pids:
+                    lo = int(starts_e[i])
+                    hi = lo + sum(r.elems for r in group)
+                    seg = flat[lo:hi]
+                    if isinstance(seg, np.ndarray):
+                        # host-compacted: the merged slab moves in ONE
+                        # put (a class-exact segment adopts with no
+                        # pad program and no second copy)
+                        import jax
 
-                    seg = jax.device_put(seg, self._dev.device)
-                dev = self._dev.get(seg.size * itemsize)
-                try:
-                    dev = dev.put_array(seg)
-                except Exception:
-                    dev.free()
-                    raise
-                out.append(CollectiveResult(
-                    pid, dev, [r.loc for r in group], True
-                ))
-                self._m_fused.inc()
-            else:
-                for k, r in enumerate(wave.rows[i:j]):
-                    if not r.live:
-                        continue
-                    rowv = stacked_dev[i + k, : r.elems]
-                    dev = self._dev.get(r.elems * itemsize)
+                        seg = jax.device_put(seg, self._dev.device)
+                    dev = self._dev.get(seg.size * itemsize)
                     try:
-                        dev = dev.put_array(rowv)
+                        dev = dev.put_array(seg)
                     except Exception:
                         dev.free()
                         raise
-                    out.append(CollectiveResult(pid, dev, [r.loc], False))
+                    out.append(CollectiveResult(
+                        pid, dev, [r.loc for r in group], True
+                    ))
+                    self._m_fused.inc()
+                else:
+                    for k, r in enumerate(wave.rows[i:j]):
+                        if not r.live:
+                            continue
+                        nbytes = r.elems * itemsize
+                        if (i + k) in row_arrs:
+                            # fast lane: the landed slab-class array
+                            # swaps in whole (classes match — no pad
+                            # program, no second transfer)
+                            dev = self._dev.get(nbytes)
+                            try:
+                                dev = dev.put_array(row_arrs[i + k])
+                            except Exception:
+                                dev.free()
+                                raise
+                            dev.length = nbytes
+                        elif (i + k) in row_views:
+                            # fused-pid row whose partition unfused
+                            # mid-stage: stage its zero-copy source
+                            # view (pins are still held)
+                            dev = self._dev.stage_view(
+                                row_views[i + k], nbytes, dtype,
+                            )
+                        elif stacked_dev is not None:
+                            rowv = stacked_dev[i + k, : r.elems]
+                            dev = self._dev.get(nbytes)
+                            try:
+                                dev = dev.put_array(rowv)
+                            except Exception:
+                                dev.free()
+                                raise
+                        else:
+                            # assembled row: exact payload through the
+                            # compile-free staging path
+                            dev = self._dev.stage_view(
+                                stacked_host[i + k, : r.elems],
+                                nbytes, dtype,
+                            )
+                        out.append(
+                            CollectiveResult(pid, dev, [r.loc], False)
+                        )
+            except Exception:
+                logger.exception(
+                    "wave adoption failed for partition %d; degrading", pid
+                )
+                failed.extend(group)
             i = j
-        return out
+        return out, failed
 
 
 def _null_ctx():

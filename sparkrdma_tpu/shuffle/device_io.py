@@ -524,56 +524,24 @@ class DeviceShuffleIO:
                         _start_read(mgr, arrivals, len(pending), loc, reg, ch)
                     )
 
-            for loc in cplan.passthrough:
-                _issue(loc)
-            # compiled waves run NOW, while the host READs issued above
-            # are in flight — DMA epochs overlap host-plane transport
-            results, degraded = self._collective.execute(
-                shuffle_id, cplan, dtype, fused=fused
-            )
-            for r in results:
-                out.setdefault(r.pid, []).append(r.dev)
-            # rows the waves lost (evicted mid-stage, mover surprise)
-            # re-issue through the host path: silent, byte-identical
-            for loc in degraded:
-                _issue(loc, allow_pull=False)
-
-            remaining = {i for i, e in enumerate(pending) if e is not None}
             refetched: set = set()
-            while remaining:
-                budget = deadline - time.monotonic()
-                tw = time.perf_counter()
-                try:
-                    if budget > 0:
-                        idx = arrivals.get(timeout=budget)
-                    else:
-                        # the deadline bounds the WAITING, not the
-                        # consumption of reads that already landed:
-                        # staging time (host->HBM transfers) may have
-                        # eaten the budget while completions queued up —
-                        # drain those without blocking before failing
-                        idx = arrivals.get_nowait()
-                except queue.Empty:
-                    # the final (possibly full-budget) wait is transport
-                    # time too — without this the failure case records
-                    # near-zero transport for a fetch that spent its
-                    # whole wall waiting on it
-                    t_transport += time.perf_counter() - tw
-                    # deadline spent with reads still outstanding
-                    slow = pending[next(iter(remaining))][0]
-                    raise FetchFailedError(
-                        slow.manager_id, shuffle_id, -1, slow.partition_id,
-                        f"fetch deadline ({timeout_s:.1f}s) exceeded with "
-                        f"{len(remaining)} block(s) outstanding",
-                    )
-                t_transport += time.perf_counter() - tw
-                if idx not in remaining:
-                    continue  # duplicate completion post
-                loc, obj, done, errbox, _abandon = pending[idx]
+
+            def _process_arrival(idx):
+                """Consume one posted completion: error gate, checksum
+                gate (one same-source refetch), then host->HBM staging.
+                Shared by the blocking drain loop below and the
+                non-blocking drain the wave pipeline calls between
+                entries — passthrough READs stage WHILE waves are in
+                flight instead of queueing behind the last one."""
+                nonlocal t_stage, n_bytes
+                entry = pending[idx]
+                if entry is None:
+                    return  # duplicate completion post
+                loc, obj, done, errbox, _abandon = entry
                 if not done.is_set():
                     # stale post from a superseded (refetched) attempt;
                     # the live read posts idx again on completion
-                    continue
+                    return
                 if errbox:
                     mgr.health.record_failure(loc.manager_id.executor_id)
                     raise FetchFailedError(
@@ -612,7 +580,7 @@ class DeviceShuffleIO:
                     else:
                         reg2 = mgr.buffer_manager.get(loc.block.length)
                         pending[idx] = _start_read(mgr, arrivals, idx, loc, reg2, ch)
-                    continue
+                    return
                 mgr.health.record_success(loc.manager_id.executor_id)
                 ts = time.perf_counter()
                 if isinstance(obj, dict):
@@ -636,8 +604,63 @@ class DeviceShuffleIO:
                 t_stage += time.perf_counter() - ts
                 n_bytes += loc.block.length
                 pending[idx] = None
-                remaining.discard(idx)
                 out.setdefault(loc.partition_id, []).append(dev)
+
+            def _drain_ready():
+                # non-blocking: consume whatever already landed, return
+                # the moment the queue is dry — never waits on transport
+                while True:
+                    try:
+                        idx = arrivals.get_nowait()
+                    except queue.Empty:
+                        return
+                    _process_arrival(idx)
+
+            for loc in cplan.passthrough:
+                _issue(loc)
+            # compiled waves run NOW, while the host READs issued above
+            # are in flight — DMA epochs overlap host-plane transport,
+            # and the drain callback consumes landed READs between
+            # pipeline entries (before the waves finish)
+            results, degraded = self._collective.execute(
+                shuffle_id, cplan, dtype, fused=fused, drain=_drain_ready
+            )
+            for r in results:
+                out.setdefault(r.pid, []).append(r.dev)
+            # rows the waves lost (evicted mid-stage, mover surprise)
+            # re-issue through the host path: silent, byte-identical
+            for loc in degraded:
+                _issue(loc, allow_pull=False)
+
+            while any(e is not None for e in pending):
+                budget = deadline - time.monotonic()
+                tw = time.perf_counter()
+                try:
+                    if budget > 0:
+                        idx = arrivals.get(timeout=budget)
+                    else:
+                        # the deadline bounds the WAITING, not the
+                        # consumption of reads that already landed:
+                        # staging time (host->HBM transfers) may have
+                        # eaten the budget while completions queued up —
+                        # drain those without blocking before failing
+                        idx = arrivals.get_nowait()
+                except queue.Empty:
+                    # the final (possibly full-budget) wait is transport
+                    # time too — without this the failure case records
+                    # near-zero transport for a fetch that spent its
+                    # whole wall waiting on it
+                    t_transport += time.perf_counter() - tw
+                    # deadline spent with reads still outstanding
+                    left = [e for e in pending if e is not None]
+                    slow = left[0][0]
+                    raise FetchFailedError(
+                        slow.manager_id, shuffle_id, -1, slow.partition_id,
+                        f"fetch deadline ({timeout_s:.1f}s) exceeded with "
+                        f"{len(left)} block(s) outstanding",
+                    )
+                t_transport += time.perf_counter() - tw
+                _process_arrival(idx)
             return out
         except Exception:
             # release everything: staged device slabs are freed here;
@@ -759,42 +782,18 @@ class DeviceShuffleIO:
                         _start_read(mgr, arrivals, len(pending), loc, reg, ch)
                     )
 
-            for loc in cplan.passthrough:
-                _issue(loc)
-            # waves overlap the in-flight host READs issued above
-            results, degraded = self._collective.execute(
-                shuffle_id, cplan, dtype, fused=False
-            )
-            for r in results:
-                out.setdefault(r.pid, []).append(
-                    DevicePulledBlock(shuffle_id, r.locs[0], r.dev)
-                )
-            for loc in degraded:
-                _issue(loc, allow_pull=False)
-
-            remaining = {i for i in range(len(pending))}
-            while remaining:
-                budget = deadline - time.monotonic()
-                tw = time.perf_counter()
-                try:
-                    if budget > 0:
-                        idx = arrivals.get(timeout=budget)
-                    else:
-                        idx = arrivals.get_nowait()
-                except queue.Empty:
-                    t_transport += time.perf_counter() - tw
-                    slow = pending[next(iter(remaining))][0]
-                    raise FetchFailedError(
-                        slow.manager_id, shuffle_id, -1, slow.partition_id,
-                        f"fetch deadline ({timeout_s:.1f}s) exceeded with "
-                        f"{len(remaining)} block(s) outstanding",
-                    )
-                t_transport += time.perf_counter() - tw
-                if idx not in remaining:
-                    continue  # duplicate completion post
-                loc, obj, done, errbox, _abandon = pending[idx]
+            def _process_arrival(idx):
+                """Wrap one landed READ as a HostBlock handle. Shared
+                by the blocking drain loop and the wave pipeline's
+                between-entry drain (host transport completes while
+                DMA waves are still in flight)."""
+                nonlocal n_bytes
+                entry = pending[idx]
+                if entry is None:
+                    return  # duplicate completion post
+                loc, obj, done, errbox, _abandon = entry
                 if not done.is_set():
-                    continue
+                    return
                 if errbox:
                     mgr.health.record_failure(loc.manager_id.executor_id)
                     raise FetchFailedError(
@@ -813,8 +812,50 @@ class DeviceShuffleIO:
                     )
                 n_bytes += loc.block.length
                 pending[idx] = None
-                remaining.discard(idx)
                 out.setdefault(loc.partition_id, []).append(hb)
+
+            def _drain_ready():
+                while True:
+                    try:
+                        idx = arrivals.get_nowait()
+                    except queue.Empty:
+                        return
+                    _process_arrival(idx)
+
+            for loc in cplan.passthrough:
+                _issue(loc)
+            # waves overlap the in-flight host READs issued above; the
+            # drain callback consumes landed READs between pipeline
+            # entries
+            results, degraded = self._collective.execute(
+                shuffle_id, cplan, dtype, fused=False, drain=_drain_ready
+            )
+            for r in results:
+                out.setdefault(r.pid, []).append(
+                    DevicePulledBlock(shuffle_id, r.locs[0], r.dev)
+                )
+            for loc in degraded:
+                _issue(loc, allow_pull=False)
+
+            while any(e is not None for e in pending):
+                budget = deadline - time.monotonic()
+                tw = time.perf_counter()
+                try:
+                    if budget > 0:
+                        idx = arrivals.get(timeout=budget)
+                    else:
+                        idx = arrivals.get_nowait()
+                except queue.Empty:
+                    t_transport += time.perf_counter() - tw
+                    left = [e for e in pending if e is not None]
+                    slow = left[0][0]
+                    raise FetchFailedError(
+                        slow.manager_id, shuffle_id, -1, slow.partition_id,
+                        f"fetch deadline ({timeout_s:.1f}s) exceeded with "
+                        f"{len(left)} block(s) outstanding",
+                    )
+                t_transport += time.perf_counter() - tw
+                _process_arrival(idx)
             return out
         except Exception:
             for blocks in out.values():

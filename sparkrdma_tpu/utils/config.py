@@ -143,6 +143,8 @@ DECLARED_KNOBS: Dict[str, str] = {
     "collective.waveBytes": "max payload bytes per DMA wave",
     "collective.fusedMerge": "allow fetch+merge fusion in one epoch",
     "collective.laneBalance": "planner balances DMA lanes, not just bytes",
+    "collective.pipelineDepth": "in-flight DMA waves in the double-buffered pipeline",
+    "collective.autoTune": "attribution-driven per-stage waveBytes self-tuning",
     "tenancy.enabled": "multi-tenant serving layer",
     "tenancy.maxConcurrentJobs": "admission in-flight job cap",
     "tenancy.admitTimeoutMs": "admission queue deadline",
@@ -874,6 +876,29 @@ class TpuShuffleConf:
         costs a longer DMA epoch than the same bytes spread across
         lanes, so reduce-range cuts weigh the max lane load."""
         return self._bool("collective.laneBalance", True)
+
+    @property
+    def collective_pipeline_depth(self) -> int:
+        """Waves the schedule compiler keeps in flight at once: wave
+        N+1's remote DMAs are dispatched while wave N still merges
+        (one DMA-semaphore array per in-flight wave). ``1`` disables
+        pipelining (issue, wait, adopt, repeat — the pre-pipeline
+        behavior); every depth is byte-identical, only the overlap
+        changes."""
+        return self._int("collective.pipelineDepth", 2, 1, 8)
+
+    @property
+    def collective_auto_tune(self) -> bool:
+        """Let the compiler's wave controller re-derive the effective
+        ``collective.waveBytes`` per (shuffle, stage-shape) from its
+        own wave stats plus the job's TimeBreakdown / profiler gap
+        frames (shuffle/autotune.py): a stage that ran as one monolithic
+        wave is re-cut so the pipeline has waves to overlap, a
+        dispatch-bound stage coarsens. The tuned choice is remembered,
+        so the second identical stage of a job already runs tuned.
+        Never shrinks a wave below the stage's largest partition group
+        (fusion needs a partition's rows in ONE wave)."""
+        return self._bool("collective.autoTune", True)
 
     @property
     def hbm_spill_dir(self) -> str:

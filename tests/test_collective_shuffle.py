@@ -364,6 +364,197 @@ def test_split_phase_collective_pull(cluster):
 
 
 # ----------------------------------------------------------------------
+# double-buffered pipeline (DESIGN.md §22 pipelining)
+# ----------------------------------------------------------------------
+def test_pipeline_depth_byte_identity_and_overlap(cluster, monkeypatch):
+    """depth>1 changes the overlap, never the bytes: the same multi-
+    wave stage fetched at depth 1 and depth 2 lands identical block
+    multisets, the overlap counter stays zero at depth 1 (nothing was
+    in flight during any issue/consume) and goes positive at depth 2."""
+    from sparkrdma_tpu.obs import attr
+
+    conf, io_map, io_red = cluster
+    # a stale breakdown from an earlier test could veto the tuner;
+    # irrelevant here but keep the stage's wave count deterministic
+    monkeypatch.setattr(attr, "_last_breakdown", None)
+    conf.set("tpu.shuffle.collective.autoTune", "false")
+    conf.set("tpu.shuffle.collective.waveBytes", "192k")
+    data = _publish_shards(io_map, seed=79)
+    overlap = _counter("collective.wave_overlap_ms", "cs-red")
+    waves = get_registry().counter(
+        "collective.waves", role="cs-red", schedule="ring"
+    )
+
+    def fetch_multiset():
+        got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+        try:
+            return {
+                p: sorted(bytes(b.read(0, b.length)) for b in got[p])
+                for p in range(3)
+            }
+        finally:
+            for bufs in got.values():
+                for b in bufs:
+                    b.free()
+
+    conf.set("tpu.shuffle.collective.pipelineDepth", "1")
+    o0, w0 = overlap.value, waves.value
+    depth1 = fetch_multiset()
+    assert waves.value - w0 > 1, "stage must cut into multiple waves"
+    assert overlap.value == o0, "depth 1 must never overlap"
+
+    conf.set("tpu.shuffle.collective.pipelineDepth", "2")
+    o1 = overlap.value
+    depth2 = fetch_multiset()
+    assert overlap.value > o1, "depth 2 must overlap issue with consume"
+
+    want = {p: sorted(a.tobytes() for a in data[p]) for p in range(3)}
+    assert depth1 == want
+    assert depth2 == want
+
+
+def test_pipeline_drain_on_midstage_abort(cluster, monkeypatch):
+    """A wave that dies mid-pipeline (its landing wait fails while the
+    next wave's transfers are already airborne) degrades ITS rows to
+    the host triple without unwinding the stage: output byte-identical,
+    every pin released, no slab leaked on either endpoint."""
+    from sparkrdma_tpu.obs import attr
+    from sparkrdma_tpu.ops import remote_copy
+
+    conf, io_map, io_red = cluster
+    monkeypatch.setattr(attr, "_last_breakdown", None)
+    conf.set("tpu.shuffle.collective.autoTune", "false")
+    conf.set("tpu.shuffle.collective.waveBytes", "192k")
+    conf.set("tpu.shuffle.collective.pipelineDepth", "2")
+    base_red = io_red.device_buffers.in_use_bytes
+    data = _publish_shards(io_map, seed=83)
+    degrades = _counter("collective.degrades", "cs-red")
+    d0 = degrades.value
+
+    real_wait = remote_copy.emulated_wave_wait
+    calls = {"n": 0}
+
+    def flaky_wait(inflight):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected: wave landing failed in flight")
+        return real_wait(inflight)
+
+    monkeypatch.setattr(remote_copy, "emulated_wave_wait", flaky_wait)
+    got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+    try:
+        have = {
+            p: sorted(bytes(b.read(0, b.length)) for b in got[p])
+            for p in range(3)
+        }
+        assert have == {
+            p: sorted(a.tobytes() for a in data[p]) for p in range(3)
+        }
+    finally:
+        for bufs in got.values():
+            for b in bufs:
+                b.free()
+    assert calls["n"] > 1, "injection must hit mid-pipeline, not last wave"
+    assert degrades.value - d0 > 0, "the dead wave's rows must degrade"
+    # leak checks: no pin outlives the stage on the source arena, and
+    # every local slab went back to the pool with the frees above
+    assert not io_map.device_buffers._pins
+    assert io_red.device_buffers.in_use_bytes == base_red
+
+
+def test_autotuner_converges_on_second_stage(cluster, monkeypatch):
+    """The first identical stage runs monolithic (one wave under the
+    default 64m budget) and is observed; the SECOND runs with the
+    tuner's re-cut budget (multiple waves for the pipeline to overlap)
+    and converges — no further adjustment on the third run, and no
+    slowdown from the re-cut."""
+    import time as _time
+
+    from sparkrdma_tpu.obs import attr
+
+    conf, io_map, io_red = cluster
+    # the gate must judge THIS run, not a breakdown some earlier test
+    # published; None means no veto
+    monkeypatch.setattr(attr, "_last_breakdown", None)
+    data = _publish_shards(io_map, seed=89)
+    adjusts = _counter("collective.autotune_adjustments", "cs-red")
+    waves = get_registry().counter(
+        "collective.waves", role="cs-red", schedule="ring"
+    )
+    a0 = adjusts.value
+
+    def timed_fetch():
+        t0 = _time.perf_counter()
+        w0 = waves.value
+        got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+        wall = _time.perf_counter() - t0
+        try:
+            have = {
+                p: sorted(bytes(b.read(0, b.length)) for b in got[p])
+                for p in range(3)
+            }
+        finally:
+            for bufs in got.values():
+                for b in bufs:
+                    b.free()
+        return have, wall, waves.value - w0
+
+    first, wall1, waves1 = timed_fetch()
+    assert waves1 == 1, "default budget must run the stage monolithic"
+    assert adjusts.value - a0 == 1, "first observation must re-cut"
+
+    second, wall2, waves2 = timed_fetch()
+    assert waves2 > 1, "second identical stage must run the tuned cut"
+    assert adjusts.value - a0 == 1, "same stats -> same choice: converged"
+
+    third, wall3, waves3 = timed_fetch()
+    assert waves3 == waves2
+    assert adjusts.value - a0 == 1
+
+    want = {p: sorted(a.tobytes() for a in data[p]) for p in range(3)}
+    assert first == second == third == want
+    # not-slower gate, honest about the rig: sub-resolution walls say
+    # nothing about a regression either way (the structural asserts
+    # above are the convergence proof regardless)
+    if wall1 < 0.02:
+        pytest.skip(
+            f"stage wall {wall1 * 1e3:.1f}ms below timing resolution on "
+            "this rig; cannot resolve the not-slower comparison"
+        )
+    assert min(wall2, wall3) <= wall1 * 2.5 + 0.05, (
+        "tuned stage must not be slower than the untuned first run"
+    )
+
+
+def test_autotuner_converges_structurally(cluster, monkeypatch):
+    """Timing-free half of the convergence proof (the not-slower test
+    above may skip on rigs whose stage wall is below resolution): the
+    second identical stage plans with the tuned budget and the choice
+    is stable across runs."""
+    from sparkrdma_tpu.obs import attr
+
+    conf, io_map, io_red = cluster
+    monkeypatch.setattr(attr, "_last_breakdown", None)
+    _publish_shards(io_map, seed=97)
+    adjusts = _counter("collective.autotune_adjustments", "cs-red")
+    waves = get_registry().counter(
+        "collective.waves", role="cs-red", schedule="ring"
+    )
+    a0 = adjusts.value
+    per_run = []
+    for _ in range(3):
+        w0 = waves.value
+        got = io_red.fetch_device_blocks(91, 0, 3, timeout_s=30)
+        for bufs in got.values():
+            for b in bufs:
+                b.free()
+        per_run.append(waves.value - w0)
+    assert per_run[0] == 1
+    assert per_run[1] > 1 and per_run[2] == per_run[1]
+    assert adjusts.value - a0 == 1
+
+
+# ----------------------------------------------------------------------
 # lane-balanced reduce cuts (planner-level)
 # ----------------------------------------------------------------------
 def test_planner_lane_balanced_cuts():

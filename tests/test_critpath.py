@@ -287,7 +287,7 @@ def test_trend_covers_every_committed_bench_round():
     from sparkrdma_tpu.obs.trend import build_trend
 
     trend = build_trend(str(REPO_ROOT))
-    assert trend["rounds"]["bench"] == [1, 2, 3, 4, 5, 6, 7]
+    assert trend["rounds"]["bench"] == [1, 2, 3, 4, 5, 6, 7, 8]
     assert not trend["errors"], trend["errors"]
     assert not trend["regressions"], trend["regressions"]
     assert trend["num_series"] > 100
@@ -338,6 +338,43 @@ def test_trend_stale_series_chart_but_do_not_gate(tmp_path):
     assert trend_main(argv) == 0
     trend = build_trend(str(tmp_path))
     assert trend["series"]["bench.a_gbps"].get("stale") is True
+
+
+def test_trend_rig_normalized_gate_forgives_slower_rig(tmp_path):
+    from sparkrdma_tpu.obs.trend import build_trend, main as trend_main
+
+    # the rig halved (probe 2.0 -> 1.0) and read_gbps halved with it:
+    # the roofline fraction is flat, so nothing actionable regressed —
+    # and the probe itself never gates (it measures the machine)
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"read_gbps": 1.6, "exchange_loopback_gbps": 2.0}})
+    _write(tmp_path / "BENCH_r02.json",
+           {"parsed": {"read_gbps": 0.8, "exchange_loopback_gbps": 1.0}})
+    argv = ["--dir", str(tmp_path), "--out", str(tmp_path / "TREND.json"),
+            "--md", str(tmp_path / "TREND.md"), "--check"]
+    assert trend_main(argv) == 0
+    trend = build_trend(str(tmp_path))
+    assert trend["series"]["bench.exchange_loopback_gbps"].get(
+        "rig_probe") is True
+    assert trend["series"]["bench.read_gbps"].get(
+        "rel_delta_normalized") == 0.0
+
+
+def test_trend_rig_normalized_gate_still_catches_code_regressions(tmp_path):
+    from sparkrdma_tpu.obs.trend import main as trend_main
+
+    # same rig both rounds (probe flat) but read_gbps dropped 60%:
+    # normalization must not launder a genuine regression
+    _write(tmp_path / "BENCH_r01.json",
+           {"parsed": {"read_gbps": 1.6, "exchange_loopback_gbps": 2.0}})
+    _write(tmp_path / "BENCH_r02.json",
+           {"parsed": {"read_gbps": 0.64, "exchange_loopback_gbps": 2.0}})
+    argv = ["--dir", str(tmp_path), "--out", str(tmp_path / "TREND.json"),
+            "--md", str(tmp_path / "TREND.md"), "--check"]
+    assert trend_main(argv) == 1
+    trend = json.loads((tmp_path / "TREND.json").read_text())
+    assert trend["regressions"][0]["series"] == "bench.read_gbps"
+    assert trend["regressions"][0]["rig_normalized"] is True
 
 
 def test_trend_flattens_workloads_and_soak(tmp_path):
