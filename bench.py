@@ -1637,6 +1637,162 @@ def _sendfile_roofline() -> float:
 
 
 # ---------------------------------------------------------------------------
+# columnar block format: decode-path A/B (DESIGN.md §25)
+# ---------------------------------------------------------------------------
+
+def bench_columnar_decode_ab(dry_run: bool = False) -> dict:
+    """Interleaved pickle-decode vs columnar-decode A/B over identical
+    record sets (DESIGN.md §25, ``bench.py --ab columnar_decode``).
+
+    Both sides consume the exact framed partition stream the reduce
+    pipeline fetches (length-prefixed frames through
+    ``iter_compressed_blocks``), built from the same (uint32, int64)
+    records by the real writers. The PICKLE side measures the legacy
+    decode stage end to end: zlib decompress + ``load_buffer`` row
+    materialization. The COLUMNAR side measures what that stage
+    degenerated to for the analytic/device consumers: header validation
+    + ``np.frombuffer`` column views, plus a full-column reduction so
+    every landed byte is actually read (views alone would time header
+    parsing, not the record plane). ``row_gbps`` additionally reports
+    the columnar path when per-row tuples ARE materialized
+    (``iter_records``) — the host reader's shape — kept in the record
+    for honesty: the gated headline is the column-scan decode, which is
+    what the zero-copy format exists for. Decode is single-threaded
+    pure CPU on both sides, so the A/B is fair at any core count;
+    ``cores`` rides along for the ledger (the honest-caveat pattern the
+    other rows follow). Gate: column-scan decode ≥ 1.5x pickle, or a
+    loud ``gate_skip_reason``."""
+    import io
+    import os
+
+    from sparkrdma_tpu.engine.serializer import (
+        CompressionCodec,
+        frame_compressed,
+        iter_compressed_blocks,
+        PickleSerializer,
+    )
+    from sparkrdma_tpu.shuffle import columnar as col
+    from sparkrdma_tpu.shuffle.writer.columnar import ColumnarPartitionWriter
+
+    rows = 40_000 if dry_run else 400_000
+    n_pairs = 2 if dry_run else 5
+    rng = np.random.default_rng(33)
+    keys = rng.integers(0, 1 << 32, rows, dtype=np.uint32)
+    vals = rng.integers(0, 1 << 31, rows, dtype=np.int64)
+    records = [(k, v) for k, v in zip(keys, vals)]
+    logical_bytes = keys.nbytes + vals.nbytes
+    codec = CompressionCodec(enabled=True)
+    ser = PickleSerializer()
+
+    # pickle stream: the legacy sort-file framing (256 KiB flushes)
+    import pickle as _pickle
+    import struct as _struct
+
+    pack = _struct.Struct(">I").pack
+    pkl_stream = bytearray()
+    buf = bytearray()
+    for rec in records:
+        data = _pickle.dumps(rec, protocol=_pickle.HIGHEST_PROTOCOL)
+        buf += pack(len(data))
+        buf += data
+        if len(buf) >= (256 << 10):
+            pkl_stream += frame_compressed(codec, bytes(buf))
+            buf.clear()
+    if buf:
+        pkl_stream += frame_compressed(codec, bytes(buf))
+    pkl_stream = bytes(pkl_stream)
+
+    # columnar stream: the real partition writer, default batch rows
+    chunks = []
+    cw = ColumnarPartitionWriter(codec, chunks.append, batch_rows=4096)
+    for rec in records:
+        cw.write_record(rec)
+    cw.flush_batch()
+    assert cw.all_columnar, "bench records must conform"
+    col_stream = b"".join(chunks)
+
+    expect_keys = int(keys.sum(dtype=np.uint64) & 0xFFFFFFFFFFFFFFFF)
+
+    def decode_pickle():
+        n, ksum = 0, 0
+        for block in iter_compressed_blocks(io.BytesIO(pkl_stream), codec):
+            recs = list(ser.load_buffer(block))
+            n += len(recs)
+            ksum += int(np.add.reduce([int(r[0]) for r in recs]))
+        return n, ksum & 0xFFFFFFFFFFFFFFFF
+
+    def decode_columnar_scan():
+        n, ksum, vsum = 0, 0, 0
+        for block in iter_compressed_blocks(io.BytesIO(col_stream), codec):
+            cols = col.decode_columns(block)
+            n += len(cols[0])
+            ksum += int(cols[0].sum(dtype=np.uint64))
+            vsum += int(cols[1].sum(dtype=np.int64))
+        return n, ksum & 0xFFFFFFFFFFFFFFFF
+
+    def decode_columnar_rows():
+        n = 0
+        for block in iter_compressed_blocks(io.BytesIO(col_stream), codec):
+            n += len(list(col.iter_records(block)))
+        return n
+
+    # byte identity before timing: both sides see every row
+    n_p, sum_p = decode_pickle()
+    n_c, sum_c = decode_columnar_scan()
+    if n_p != rows or n_c != rows or sum_p != expect_keys or sum_c != expect_keys:
+        raise SystemExit("BENCH FAILED: columnar A/B decode sums differ")
+
+    pairs = []
+    for _ in range(n_pairs):
+        t0 = time.perf_counter()
+        decode_pickle()
+        t_p = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        decode_columnar_scan()
+        t_c = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        decode_columnar_rows()
+        t_r = time.perf_counter() - t0
+        pairs.append({
+            "pickle_gbps": round(logical_bytes / t_p / 1e9, 4),
+            "columnar_gbps": round(logical_bytes / t_c / 1e9, 4),
+            "columnar_row_gbps": round(logical_bytes / t_r / 1e9, 4),
+        })
+    med_p = float(np.median([p["pickle_gbps"] for p in pairs]))
+    med_c = float(np.median([p["columnar_gbps"] for p in pairs]))
+    med_r = float(np.median([p["columnar_row_gbps"] for p in pairs]))
+    speedup = round(med_c / med_p, 3) if med_p else None
+    gate_evaluated = not dry_run and speedup is not None
+    gate_skip_reason = None
+    if not gate_evaluated:
+        gate_skip_reason = (
+            "dry run: volume too small to resolve decode throughput"
+            if dry_run else "no throughput measured"
+        )
+    if gate_evaluated and speedup < 1.5:
+        raise SystemExit(
+            f"BENCH FAILED: columnar decode {speedup}x < 1.5x over pickle "
+            f"(pickle {med_p:.3f} GB/s, columnar {med_c:.3f} GB/s)"
+        )
+    return {
+        "ab_columnar_decode": {
+            "pairs": pairs,
+            "rows": rows,
+            "logical_mb": round(logical_bytes / 1e6, 3),
+            "pickle_gbps": round(med_p, 4),
+            "columnar_gbps": round(med_c, 4),
+            "row_gbps": round(med_r, 4),
+            "decode_speedup": speedup,
+            "columnar_framed_bytes": len(col_stream),
+            "pickle_framed_bytes": len(pkl_stream),
+            "cores": os.cpu_count() or 1,
+            "gate_evaluated": gate_evaluated,
+            "gate_skip_reason": gate_skip_reason,
+        }
+    }
+
+
+# ---------------------------------------------------------------------------
 # device plane: chained-jit differencing (see module docstring)
 # ---------------------------------------------------------------------------
 
@@ -1843,7 +1999,8 @@ def main() -> None:
         "--ab",
         default="",
         choices=["", "device_fetch", "concurrent_jobs", "iouring_read",
-                 "consume_sharded", "profiler_overhead", "slo_overhead"],
+                 "consume_sharded", "profiler_overhead", "slo_overhead",
+                 "columnar_decode"],
         help="run ONE A/B at reduced volume and print its JSON — the CI "
         "obs smoke's dry-run mode (e.g. --ab device_fetch)",
     )
@@ -1855,6 +2012,7 @@ def main() -> None:
         "consume_sharded": bench_consume_sharded_ab,
         "profiler_overhead": bench_profiler_overhead_ab,
         "slo_overhead": bench_slo_overhead_ab,
+        "columnar_decode": bench_columnar_decode_ab,
     }
     if args.ab:
         record = dry_abs[args.ab](dry_run=True)
@@ -1892,6 +2050,7 @@ def main() -> None:
     out.update(bench_concurrent_jobs_ab())
     out.update(bench_profiler_overhead_ab())
     out.update(bench_slo_overhead_ab())
+    out.update(bench_columnar_decode_ab())
     import jax
 
     out.update(bench_device(jax))

@@ -812,6 +812,82 @@ def bench_hashjoin(scale: float):
     report("hashjoin", dt, build=nb, probe=npr, rows_per_s=int(npr / dt))
 
 
+def bench_analytic_scan(scale: float):
+    """Analytic column scan over shuffled blocks (DESIGN.md §25): the
+    same typed record set staged through both block encodings, then one
+    full-column aggregate (sum of the value column) consumed straight
+    off the framed partition stream. The columnar side decodes via
+    zero-copy ``np.frombuffer`` views and reduces vectorized; the
+    pickle side must materialize every row tuple first — the decode
+    delta IS the workload, so both scans run on one core and the row
+    reports both times plus the speedup. Results are asserted equal."""
+    import io
+
+    from sparkrdma_tpu.engine.serializer import (
+        CompressionCodec,
+        PickleSerializer,
+        frame_compressed,
+        iter_compressed_blocks,
+    )
+    from sparkrdma_tpu.shuffle import columnar
+    from sparkrdma_tpu.shuffle.writer.columnar import ColumnarPartitionWriter
+
+    n = int(4_000_000 * scale * 20)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    vals = rng.integers(0, 1 << 30, n, dtype=np.int64)
+    records = [(k, v) for k, v in zip(keys, vals)]
+    logical_bytes = keys.nbytes + vals.nbytes
+    codec = CompressionCodec(enabled=True)
+
+    chunks = []
+    cw = ColumnarPartitionWriter(codec, chunks.append, batch_rows=4096)
+    for rec in records:
+        cw.write_record(rec)
+    cw.flush_batch()
+    col_stream = b"".join(chunks)
+
+    import pickle
+    import struct
+
+    pack = struct.Struct(">I").pack
+    pkl_stream = bytearray()
+    buf = bytearray()
+    for rec in records:
+        data = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        buf += pack(len(data))
+        buf += data
+        if len(buf) >= (256 << 10):
+            pkl_stream += frame_compressed(codec, bytes(buf))
+            buf.clear()
+    if buf:
+        pkl_stream += frame_compressed(codec, bytes(buf))
+
+    t0 = time.perf_counter()
+    col_sum = 0
+    for block in iter_compressed_blocks(io.BytesIO(col_stream), codec):
+        col_sum += int(columnar.decode_columns(block)[1].sum(dtype=np.int64))
+    dt_col = time.perf_counter() - t0
+
+    ser = PickleSerializer()
+    t0 = time.perf_counter()
+    pkl_sum = 0
+    for block in iter_compressed_blocks(io.BytesIO(bytes(pkl_stream)), codec):
+        pkl_sum += sum(int(r[1]) for r in ser.load_buffer(block))
+    dt_pkl = time.perf_counter() - t0
+
+    assert col_sum == pkl_sum == int(vals.sum(dtype=np.int64))
+    report(
+        "analytic_scan", dt_col,
+        rows=n,
+        logical_mb=round(logical_bytes / 1e6, 1),
+        columnar_scan_gbps=round(logical_bytes / dt_col / 1e9, 4),
+        pickle_scan_gbps=round(logical_bytes / dt_pkl / 1e9, 4),
+        pickle_seconds=round(dt_pkl, 4),
+        scan_speedup=round(dt_pkl / dt_col, 2) if dt_col else None,
+    )
+
+
 def enable_compile_cache() -> None:
     """Persistent XLA compilation cache (the SVC amortization the
     reference gets from stateful verb calls, RdmaChannel.java:185-192:
@@ -847,7 +923,7 @@ if __name__ == "__main__":
     ap.add_argument(
         "--only", default=None,
         choices=[None, "engine", "terasort", "skew", "e2e", "train",
-                 "pagerank", "als", "join"],
+                 "pagerank", "als", "join", "scan"],
     )
     ap.add_argument(
         "--e2e-gb", type=float, default=0.0,
@@ -872,6 +948,7 @@ if __name__ == "__main__":
         "pagerank": lambda: bench_pagerank(args.scale),
         "als": lambda: bench_als(args.scale),
         "join": lambda: bench_hashjoin(args.scale),
+        "scan": lambda: bench_analytic_scan(args.scale),
     }
     if args.only == "e2e" and args.e2e_gb <= 0:
         ap.error("--only e2e requires --e2e-gb > 0")

@@ -23,6 +23,11 @@ from typing import BinaryIO, Iterator, Tuple
 
 _LEN = struct.Struct(">I")
 
+# shuffle/columnar.py MAGIC_BYTES, duplicated because the engine layer
+# must not import the shuffle package (circular: shuffle.manager imports
+# this module). Pinned equal by tests/test_columnar.py.
+_COLUMNAR_MAGIC = b"\xa7\xc1"
+
 
 class Serializer:
     name = "base"
@@ -110,6 +115,18 @@ def frame_compressed(codec: CompressionCodec, raw: bytes) -> bytes:
     return _LEN.pack(len(block)) + block
 
 
+def frame_columnar(payload: bytes) -> bytes:
+    """Length-prefix one columnar payload, UNCOMPRESSED.
+
+    Columnar blocks skip the codec on both sides: compression would
+    force a decompress copy on read, destroying the zero-copy column
+    views, and the payload's magic (shuffle/columnar.py: 0xA7C1 —
+    impossible as a zlib header byte or a sane record length) lets
+    ``iter_compressed_blocks`` tell the two frame kinds apart, so
+    pickle and columnar frames interleave freely in one block."""
+    return _LEN.pack(len(payload)) + payload
+
+
 class CompressedBlockWriter:
     """Accumulates serialized bytes, emits one compressed block on flush.
 
@@ -151,8 +168,15 @@ def iter_compressed_blocks(inp: BinaryIO, codec: CompressionCodec) -> Iterator[b
     compressed frame never materializes as a bytes object. Yielded
     blocks derived from such views are only valid until the stream
     closes; consumers decode fully before closing.
+
+    Columnar frames (first payload bytes = the 0xA7C1 magic,
+    shuffle/columnar.py) are framed uncompressed and yielded as-is —
+    the raw view passes straight through to the column decoder, never
+    touching the codec. Callers sniff the magic per yielded block to
+    pick the decode path.
     """
     read_block = getattr(inp, "read_view", inp.read)
+    magic = _COLUMNAR_MAGIC
     while True:
         header = inp.read(4)
         if len(header) < 4:
@@ -163,4 +187,7 @@ def iter_compressed_blocks(inp: BinaryIO, codec: CompressionCodec) -> Iterator[b
         block = read_block(n)
         if len(block) < n:
             raise EOFError("truncated compressed block")
-        yield codec.decompress(block)
+        if n > 2 and bytes(block[:2]) == magic:
+            yield block
+        else:
+            yield codec.decompress(block)

@@ -54,6 +54,14 @@ class BlockLocation:
     count, and the originals always remain the durable fallback. Rides
     a trailing frame extension (rpc.py), never the legacy 16-byte form.
 
+    ``block_format`` names the payload encoding of the staged bytes:
+    0 = pickle frame stream (the universal default), 1 = every frame
+    in the block is fixed-width columnar (shuffle/columnar.py) — the
+    collective compiler may admit such blocks into DMA waves and the
+    reduce side decodes them as memoryview column slices. Rides the
+    trailing format extension (rpc.py), never the legacy 16-byte form:
+    legacy frames stay byte-identical when every block is pickle.
+
     ``replica_of``/``source_map`` are the elastic layer's lineage tag
     (sparkrdma_tpu/elastic/): ``source_map`` names the map task that
     produced the bytes (-1 = unattributed, e.g. chunked-agg finalize
@@ -76,8 +84,17 @@ class BlockLocation:
     merged_cover: int = 0
     replica_of: str = ""
     source_map: int = -1
+    block_format: int = 0
 
     SERIALIZED_SIZE = _BLOCK.size
+
+    FORMAT_PICKLE = 0
+    FORMAT_COLUMNAR = 1
+
+    @property
+    def is_columnar(self) -> bool:
+        """True when the staged payload is the columnar block format."""
+        return self.block_format == self.FORMAT_COLUMNAR
 
     @property
     def has_device(self) -> bool:

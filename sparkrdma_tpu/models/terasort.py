@@ -124,6 +124,24 @@ class MapShardSorter:
         )
         return local, bounds
 
+    def sort_columnar_partition(
+        self, frame, edges: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """:meth:`sort_partition` taken straight off a columnar block
+        (DESIGN.md §25): column 0 of ``frame`` is the uint32 key column,
+        decoded as an ``np.frombuffer`` view aliasing the landed bytes —
+        the view feeds the size-class pad copy directly, so consuming a
+        fetched shuffle block on-device costs header validation plus
+        the one HBM DMA. No pickle, no per-record tuples."""
+        from sparkrdma_tpu.shuffle import columnar
+
+        keys = columnar.decode_columns(frame)[0]
+        if keys.dtype != np.uint32:
+            raise TypeError(
+                f"columnar key column is {keys.dtype}, expected uint32"
+            )
+        return self.sort_partition(keys, edges)
+
 
 class TeraSorter:
     """Compile-once global sorter over a device mesh.

@@ -49,6 +49,11 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "admission.wait_ms": ("histogram", _L({"tenant"})),
     "admission.inflight": ("gauge", _L({"role"})),
     "admission.queue_depth": ("gauge", _L({"role"})),
+    # columnar block format (shuffle/columnar.py, writer/columnar.py)
+    "block.columnar_blocks": ("counter", _L({"role"})),
+    "block.columnar_bytes": ("counter", _L({"role"})),
+    "block.pickle_fallbacks": ("counter", _L({"role"})),
+    "block.view_decodes": ("counter", _L({"role"})),
     # whole-stage collective shuffle (shuffle/collective.py, planner.py)
     "collective.plans": ("counter", _L({"role"})),
     "collective.waves": ("counter", _L({"role", "schedule"})),

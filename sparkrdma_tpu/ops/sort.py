@@ -49,6 +49,26 @@ import jax
 import jax.numpy as jnp
 
 
+def device_put_columns(frame, device=None):
+    """Pickle-free device staging of one columnar block (DESIGN.md §25).
+
+    ``frame`` is a columnar frame's bytes/memoryview as landed by the
+    fetch path (shuffle/columnar.py). Its fixed-width columns decode as
+    ``np.frombuffer`` views ALIASING the landed buffer — zero host
+    copies — and each view stages to the device as one contiguous DMA
+    (``jax.device_put`` / ``jnp.asarray``). No pickle decode, no
+    per-record tuples, no per-block ``bytes()`` materialization: the
+    whole host-side cost of consuming a shuffle block on-device is the
+    header validation. Returns one ``jax.Array`` per column.
+    """
+    from sparkrdma_tpu.shuffle import columnar
+
+    cols = columnar.decode_columns(frame)
+    if device is None:
+        return [jnp.asarray(c) for c in cols]
+    return [jax.device_put(c, device) for c in cols]
+
+
 def device_sort(x: jax.Array) -> jax.Array:
     """The framework's exact device sort (ascending, any shape's last axis
     or flat 1-D).

@@ -187,6 +187,15 @@ class PublishPartitionLocationsMsg(RpcMsg):
     # extension bytes — legacy frames stay byte-identical.
     _EPO_MARKER = 0xFFFA
     _EPO_ITEM = struct.Struct(">Q")
+    # per-segment block-format extension (columnar block format,
+    # shuffle/columnar.py): written AFTER the elastic extension, BEFORE
+    # the follows extension. Same impossible-host-length marker trick
+    # with 0xFFF9. Layout: _EXT_HDR, then per location block_format(u1);
+    # 0 = pickle frame stream (the default). Publishes where every
+    # block is pickle emit zero extension bytes — legacy frames stay
+    # byte-identical.
+    _FMT_MARKER = 0xFFF9
+    _FMT_ITEM = struct.Struct(">B")
 
     def to_segments(self, seg_size: int) -> List[bytes]:
         has_ck = any(loc.block.checksum_algo for loc in self.locations)
@@ -203,6 +212,9 @@ class PublishPartitionLocationsMsg(RpcMsg):
             for loc in self.locations
         )
         ela_fixed = self._EXT_HDR.size if has_ela else 0
+        has_fmt = any(loc.block.block_format for loc in self.locations)
+        fmt_fixed = self._EXT_HDR.size if has_fmt else 0
+        fmt_per_loc = self._FMT_ITEM.size if has_fmt else 0
         flw_fixed = (
             self._EXT_HDR.size + self._FLW_ITEM.size if self.origin_span else 0
         )
@@ -218,6 +230,7 @@ class PublishPartitionLocationsMsg(RpcMsg):
             - dev_fixed
             - mrg_fixed
             - ela_fixed
+            - fmt_fixed
             - flw_fixed
             - epo_fixed
         )
@@ -226,7 +239,10 @@ class PublishPartitionLocationsMsg(RpcMsg):
         groups: List[List[PartitionLocation]] = [[]]
         used = 0
         for loc in self.locations:
-            sz = loc.serialized_size() + ck_per_loc + dev_per_loc + mrg_per_loc
+            sz = (
+                loc.serialized_size()
+                + ck_per_loc + dev_per_loc + mrg_per_loc + fmt_per_loc
+            )
             if has_ela:
                 # variable per-loc cost: fixed item + the replica id bytes
                 sz += self._ELA_ITEM.size + len(loc.block.replica_of.encode())
@@ -284,6 +300,10 @@ class PublishPartitionLocationsMsg(RpcMsg):
                     rep = loc.block.replica_of.encode("utf-8")
                     buf.write(self._ELA_ITEM.pack(loc.block.source_map, len(rep)))
                     buf.write(rep)
+            if has_fmt and group:
+                buf.write(self._EXT_HDR.pack(self._FMT_MARKER, len(group)))
+                for loc in group:
+                    buf.write(self._FMT_ITEM.pack(loc.block.block_format & 0xFF))
             if self.origin_span:
                 buf.write(self._EXT_HDR.pack(self._FLW_MARKER, 1))
                 buf.write(self._FLW_ITEM.pack(self.origin_span))
@@ -389,6 +409,22 @@ class PublishPartitionLocationsMsg(RpcMsg):
                                     source_map=source_map,
                                 ),
                             )
+                    continue
+                if marker == cls._FMT_MARKER:
+                    if count == len(locs):
+                        for i in range(count):
+                            (fmt,) = cls._FMT_ITEM.unpack(
+                                inp.read(cls._FMT_ITEM.size)
+                            )
+                            if fmt:
+                                locs[i] = replace(
+                                    locs[i],
+                                    block=replace(
+                                        locs[i].block, block_format=fmt
+                                    ),
+                                )
+                    else:
+                        inp.read(count * cls._FMT_ITEM.size)
                     continue
                 if marker == cls._FLW_MARKER:
                     for _ in range(count):

@@ -228,12 +228,21 @@ class DeviceShuffleIO:
     # map side: device -> registered host memory -> locations
     # ------------------------------------------------------------------
     def stage_device_blocks(
-        self, shuffle_id: int, partitions: Dict[int, "object"]
+        self,
+        shuffle_id: int,
+        partitions: Dict[int, "object"],
+        block_format: int = 0,
     ) -> List[PartitionLocation]:
         """Stage per-partition device arrays into registered buffers and
         return their locations WITHOUT publishing — the stage half of
         the map pipeline, so the next shard's device sort can overlap
-        this shard's driver RPC (publish_staged)."""
+        this shard's driver RPC (publish_staged).
+
+        ``block_format`` tags every staged block's encoding
+        (``BlockLocation.FORMAT_*``). Device-staged bytes already carry
+        their layout in the array dtype, so columnar-encoded payloads
+        (DESIGN.md §25) advertise ``FORMAT_COLUMNAR`` here and reducers
+        consume them pickle-free straight off the arena."""
         mgr = self._manager
         conf = mgr.conf
         dev_plane = conf.device_fetch_enabled
@@ -262,7 +271,8 @@ class DeviceShuffleIO:
             if conf.resilience_checksums and nbytes:
                 ck_algo, ck = _checksum.compute(host.reshape(-1).view(np.uint8))
             block = BlockLocation(
-                0, nbytes, buf.mkey, checksum=ck, checksum_algo=ck_algo
+                0, nbytes, buf.mkey, checksum=ck, checksum_algo=ck_algo,
+                block_format=block_format,
             )
             if dev_plane and nbytes >= dev_min:
                 # keep a second, device-resident copy in the HBM arena
@@ -285,6 +295,7 @@ class DeviceShuffleIO:
                         device_coords=getattr(self._dev.device, "id", 0),
                         arena_handle=abuf.handle,
                         arena_offset=0,
+                        block_format=block_format,
                     )
             locs.append(PartitionLocation(mgr.local_manager_id, pid, block))
         # buffers go under shuffle ownership as soon as they're staged:

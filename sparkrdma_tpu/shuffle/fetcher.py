@@ -56,6 +56,7 @@ from sparkrdma_tpu.shuffle.errors import (
     FetchFailedError,
     MetadataFetchFailedError,
 )
+from sparkrdma_tpu.testing import faults as _faults
 from sparkrdma_tpu.transport import FnListener, mapped_delivery_enabled
 from sparkrdma_tpu.utils import checksum as _checksum
 
@@ -674,6 +675,11 @@ class TpuShuffleFetcherIterator:
 
     def _bad_block(self, group: AggregatedPartitionGroup, views) -> Optional[int]:
         """Index of the first checksum-mismatched block, else None."""
+        plan = _faults.active()
+        if plan is not None:
+            # block-format seam: the plan may flip a byte inside a landed
+            # columnar frame's header span — BEFORE the verify loop below
+            plan.on_block(views)
         for i, ((_pid, block), view) in enumerate(zip(group.blocks, views)):
             if not _checksum.verify(view, block.checksum, block.checksum_algo):
                 return i

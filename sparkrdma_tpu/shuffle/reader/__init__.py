@@ -26,6 +26,8 @@ from __future__ import annotations
 from typing import Iterator, List, Optional, Tuple
 
 from sparkrdma_tpu.engine.serializer import PickleSerializer, iter_compressed_blocks
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.shuffle import columnar
 from sparkrdma_tpu.shuffle.fetcher import TpuShuffleFetcherIterator
 from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, combine_by_key
 from sparkrdma_tpu.shuffle.reader.pipeline import ReduceTaskPipeline
@@ -56,15 +58,30 @@ class TpuShuffleReader:
         bytes -> decompressed block views -> record tuples. Runs on a
         decode-pool worker; the stream's registered slice / mapped
         window releases as soon as its last record materializes, so
-        zero-copy views never outlive their backing buffer."""
+        zero-copy views never outlive their backing buffer.
+
+        Columnar frames (per-block magic sniff, shuffle/columnar.py)
+        skip deserialization entirely: decode is header validation +
+        ``np.frombuffer`` column views over the landed bytes, rows
+        materialize straight off the aliased columns — the split-phase
+        decode stage degenerated to view construction (DESIGN.md §25)."""
         _pid, stream = item
         codec = self._manager.resolver.codec
         records: List[Tuple] = []
+        view_decodes = 0
         try:
             for block in iter_compressed_blocks(stream, codec):
-                records.extend(self._serializer.load_buffer(block))
+                if columnar.is_columnar(block):
+                    records.extend(columnar.iter_records(block))
+                    view_decodes += 1
+                else:
+                    records.extend(self._serializer.load_buffer(block))
         finally:
             stream.close()
+        if view_decodes:
+            get_registry().counter(
+                "block.view_decodes", role=self._manager.executor_id
+            ).inc(view_decodes)
         return records
 
     @staticmethod

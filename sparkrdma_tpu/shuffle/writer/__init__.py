@@ -9,7 +9,7 @@ semantics and are chosen purely by config (SURVEY.md §5.1 #6).
 
 from __future__ import annotations
 
-from typing import BinaryIO, List, Sequence
+from typing import BinaryIO, List, Optional, Sequence
 
 
 class ShuffleData:
@@ -25,7 +25,11 @@ class ShuffleData:
         raise NotImplementedError
 
     def write_index_file_and_commit(
-        self, map_id: int, partition_lengths: Sequence[int], data_tmp_path: str
+        self,
+        map_id: int,
+        partition_lengths: Sequence[int],
+        data_tmp_path: str,
+        partition_formats: Optional[Sequence[int]] = None,
     ) -> None:
         raise NotImplementedError
 

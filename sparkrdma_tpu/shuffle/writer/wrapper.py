@@ -44,13 +44,22 @@ class WrapperShuffleData(ShuffleData):
         self.shuffle_id = shuffle_id
         self.num_partitions = num_partitions
         self._mapped: Dict[int, MappedFile] = {}
+        # per-map per-partition block formats (BlockLocation.FORMAT_*):
+        # the columnar negotiation outcome travels with the mapped file
+        # so every publish path — writer stop, HA re-adoption sweep —
+        # advertises the same encoding tag
+        self._formats: Dict[int, List[int]] = {}
         self._lock = threading.Lock()
 
     def new_shuffle_writer(self) -> None:
         pass  # no per-writer state for this method
 
     def write_index_file_and_commit(
-        self, map_id: int, partition_lengths: Sequence[int], data_tmp_path: str
+        self,
+        map_id: int,
+        partition_lengths: Sequence[int],
+        data_tmp_path: str,
+        partition_formats: Optional[Sequence[int]] = None,
     ) -> None:
         final_path = self._resolver.data_file_path(self.shuffle_id, map_id)
         os.replace(data_tmp_path, final_path)
@@ -63,8 +72,17 @@ class WrapperShuffleData(ShuffleData):
         with self._lock:
             old = self._mapped.pop(map_id, None)
             self._mapped[map_id] = mf
+            if partition_formats is not None:
+                self._formats[map_id] = list(partition_formats)
+            else:
+                self._formats.pop(map_id, None)
         if old is not None:
             old.dispose()  # speculative re-run replaced the output
+
+    def partition_format(self, map_id: int, pid: int) -> int:
+        with self._lock:
+            formats = self._formats.get(map_id)
+        return formats[pid] if formats else 0
 
     def get_mapped_file(self, map_id: int) -> MappedFile:
         with self._lock:
@@ -105,7 +123,11 @@ class WrapperShuffleData(ShuffleData):
                 PartitionLocation(
                     manager_id,
                     pid,
-                    replace(mf.get_partition_location(pid), source_map=map_id),
+                    replace(
+                        mf.get_partition_location(pid),
+                        source_map=map_id,
+                        block_format=self.partition_format(map_id, pid),
+                    ),
                 )
                 for pid in range(mf.partition_count())
                 if mf.get_partition_location(pid).length > 0
@@ -146,14 +168,36 @@ class WrapperShuffleWriter:
         self._data: WrapperShuffleData = manager.resolver.get_or_create_shuffle_data(handle)
         self._data.new_shuffle_writer()
         self._lengths: Optional[List[int]] = None
+        self._formats: Optional[List[int]] = None
         self._stopped = False
 
     def write(self, records) -> None:
         resolver = self._manager.resolver
+        conf = self._manager.conf
         tmp = resolver.data_tmp_path(self._handle.shuffle_id, self.map_id)
-        lengths = write_sorted_file(records, self._handle, resolver.codec, tmp)
-        self._data.write_index_file_and_commit(self.map_id, lengths, tmp)
-        self._lengths = lengths
+        res = write_sorted_file(
+            records, self._handle, resolver.codec, tmp,
+            block_format=conf.block_format,
+            batch_rows=conf.block_columnar_batch_rows,
+        )
+        self._data.write_index_file_and_commit(
+            self.map_id, res.lengths, tmp, partition_formats=res.formats
+        )
+        self._lengths = res.lengths
+        self._formats = res.formats
+        if res.columnar_frames or res.pickle_fallbacks:
+            role = self._manager.executor_id
+            reg = get_registry()
+            reg.counter("block.columnar_blocks", role=role).inc(
+                res.columnar_frames
+            )
+            reg.counter("block.columnar_bytes", role=role).inc(
+                res.columnar_bytes
+            )
+            if res.pickle_fallbacks:
+                reg.counter("block.pickle_fallbacks", role=role).inc(
+                    res.pickle_fallbacks
+                )
 
     def stop(self, success: bool) -> Optional[MapStatus]:
         if self._stopped:
@@ -166,11 +210,16 @@ class WrapperShuffleWriter:
         # an all-empty map output still publishes so the driver's
         # map-output count completes
         mf = self._data.get_mapped_file(self.map_id)
+        formats = self._formats or [0] * self._handle.num_partitions
         locs = [
             PartitionLocation(
                 self._manager.local_manager_id,
                 pid,
-                replace(mf.get_partition_location(pid), source_map=self.map_id),
+                replace(
+                    mf.get_partition_location(pid),
+                    source_map=self.map_id,
+                    block_format=formats[pid],
+                ),
             )
             for pid in range(self._handle.num_partitions)
             if mf.get_partition_location(pid).length > 0

@@ -29,6 +29,12 @@ seeded, config-driven *fault plan* hooked at four seams:
     ``meta:kill:N[:shard=meta-K]`` revokes one metadata peer's lease
     and remaps its shard ranges; in-flight writes fence with a stale
     epoch and retry against the former follower
+  - ``block`` — block-format seam (shuffle/fetcher.py checksum gate):
+    ``block:corrupt_header:N`` flips one byte inside a landed columnar
+    frame's header/descriptor span (DESIGN.md §25) BEFORE
+    verification — the checksum gate must detect, the retry ladder
+    refetch, and the reduce path deliver byte-identical rows. Groups
+    with no writable columnar frame burn no budget
 
 Fault kinds: ``fail`` (listener.on_failure with :class:`InjectedFault`),
 ``delay`` (sleep ``delay_ms`` then proceed), ``corrupt`` (flip one
@@ -68,8 +74,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
 
-OPS = ("read", "send", "rpc", "stage", "push", "exec", "driver", "meta")
-KINDS = ("fail", "delay", "corrupt", "drop", "kill", "hang", "enosys")
+OPS = ("read", "send", "rpc", "stage", "push", "exec", "driver", "meta", "block")
+KINDS = (
+    "fail", "delay", "corrupt", "drop", "kill", "hang", "enosys",
+    "corrupt_header",
+)
 
 
 class InjectedFault(IOError):
@@ -396,6 +405,39 @@ class FaultPlan:
             return False
         logger.warning("fault plan: driver kill at stage %s", stage or "?")
         return True
+
+    def on_block(self, views, peer: str = "") -> None:
+        """Block-format seam (shuffle/fetcher.py ``_bad_block``): fired
+        with a fetched group's landed block views BEFORE the checksum
+        gate verifies them. ``block:corrupt_header:N`` finds the first
+        *writable* view whose leading frame is columnar
+        (shuffle/columnar.py magic behind the 4-byte length prefix) and
+        flips one deterministic byte inside the frame's
+        header + column-descriptor span — the narrowest adversary of
+        the zero-copy decode path: a corrupted dtype code or offset
+        table would mis-alias every row, so the gate must catch it
+        before a single ``np.frombuffer`` view is built. A group with
+        no writable columnar frame (pickle blocks, read-only mapped
+        page-cache windows) matches nothing and burns no budget."""
+        from sparkrdma_tpu.shuffle import columnar
+
+        target = None
+        for v in views or ():
+            if getattr(v, "readonly", True) or len(v) < 4 + columnar._HDR.size:
+                continue
+            if bytes(v[4:6]) == columnar.MAGIC_BYTES:
+                target = v
+                break
+        if target is None:
+            return
+        hit = self._match("block", peer, kinds=("corrupt_header",))
+        if hit is None:
+            return
+        _rule, fire_index = hit
+        logger.info("fault plan: corrupt columnar header from %s", peer or "?")
+        span = columnar.header_span(memoryview(target)[4:])
+        rng = random.Random((self.seed << 20) ^ fire_index)
+        target[4 + rng.randrange(span)] ^= 0xFF
 
     def on_meta(self, shard: str = "") -> bool:
         """Metadata-peer-death seam (sparkrdma_tpu/metastore): consulted

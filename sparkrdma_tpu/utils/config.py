@@ -115,6 +115,8 @@ DECLARED_KNOBS: Dict[str, str] = {
     "reduce.parallelism": "reduce decode-pool size",
     "reduce.pipelineDepth": "reduce pipeline inter-stage queue bound",
     "reduce.doubleBufferStaging": "overlap staging and device merge",
+    "block.format": "block payload encoding: auto|columnar|pickle",
+    "block.columnarBatchRows": "records per columnar frame batch",
     "push.enabled": "push-based merge of sealed blocks",
     "push.maxBufferBytes": "merge-endpoint buffered push budget",
     "publish.checksumWorkers": "publish checksum pool size (0 = inline)",
@@ -638,6 +640,25 @@ class TpuShuffleConf:
         merge of group k (double-buffered staging). Off serializes
         stage and merge on one thread."""
         return self._bool("reduce.doubleBufferStaging", True)
+
+    # -- block payload format (shuffle/columnar.py; DESIGN.md §25) --------
+    @property
+    def block_format(self) -> str:
+        """Per-shuffle block payload encoding negotiation: ``pickle``
+        is the legacy frame stream (the universal fallback),
+        ``columnar`` batches fixed-width numpy tuples into zero-copy
+        column-vector frames (per-batch pickle fallback for anything
+        the layout cannot carry), ``auto`` sniffs the first record and
+        picks. Unknown values fall back to ``auto``."""
+        raw = (self._conf.get(PREFIX + "block.format") or "auto").strip().lower()
+        return raw if raw in ("auto", "columnar", "pickle") else "auto"
+
+    @property
+    def block_columnar_batch_rows(self) -> int:
+        """Records accumulated per columnar frame batch: larger batches
+        amortize the header and widen the column vectors the collective
+        waves DMA; smaller batches bound the writer's batching memory."""
+        return self._int("block.columnarBatchRows", 4096, 16, 1 << 22)
 
     # -- push-based merge plane (shuffle/merge.py; DESIGN.md §18) ---------
     @property

@@ -1,0 +1,411 @@
+"""Columnar zero-copy block format (DESIGN.md §25): codec roundtrip +
+aliasing, frame interleaving, wire-extension roundtrip/legacy identity,
+writer negotiation, e2e pickle↔columnar byte identity, and
+collective-wave eligibility for ragged stages."""
+
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.engine import serializer
+from sparkrdma_tpu.engine.serializer import (
+    CompressionCodec,
+    frame_columnar,
+    frame_compressed,
+    iter_compressed_blocks,
+)
+from sparkrdma_tpu.locations import (
+    BlockLocation,
+    PartitionLocation,
+    ShuffleManagerId,
+)
+from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.shuffle import columnar
+from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+
+# ----------------------------------------------------------------------
+# codec
+# ----------------------------------------------------------------------
+def test_magic_constant_pinned_to_serializer_copy():
+    """The engine layer duplicates the magic (import-cycle firewall);
+    this pin is the contract that keeps the copies equal."""
+    assert serializer._COLUMNAR_MAGIC == columnar.MAGIC_BYTES
+    assert struct.pack(">H", columnar.MAGIC) == columnar.MAGIC_BYTES
+
+
+@pytest.mark.parametrize(
+    "dtypes",
+    [
+        (np.uint32,),
+        (np.uint32, np.int64),
+        (np.uint8, np.float32, np.float64),
+        (np.int16, np.uint16, np.bool_),
+        (np.int8, np.uint64, np.int32, np.float64),
+    ],
+)
+def test_batch_roundtrip_property(dtypes):
+    """Random typed batches: encode_batch -> iter_records reproduces
+    every row with identical values AND dtypes — the byte-identity
+    contract with the pickle path."""
+    rng = np.random.default_rng(42)
+    rows = 257  # deliberately not a multiple of anything
+    cols = []
+    for dt in dtypes:
+        dt = np.dtype(dt)
+        if dt == np.bool_:
+            cols.append(rng.integers(0, 2, rows).astype(dt))
+        elif dt.kind == "f":
+            cols.append(rng.standard_normal(rows).astype(dt))
+        else:
+            info = np.iinfo(dt)
+            cols.append(
+                rng.integers(info.min, int(info.max) + 1, rows, dtype=dt)
+            )
+    records = [tuple(c[i] for c in cols) for i in range(rows)]
+    payload = columnar.encode_batch(records)
+    assert payload is not None
+    decoded = list(columnar.iter_records(payload))
+    assert len(decoded) == rows
+    for orig, got in zip(records, decoded):
+        for a, b in zip(orig, got):
+            assert a.dtype == b.dtype
+            assert a == b or (a != a and b != b)  # NaN-safe equality
+    # the framed length is always a multiple of 8 — the collective
+    # eligibility invariant
+    assert (4 + len(payload)) % 8 == 0
+
+
+def test_decode_aliases_buffer_zero_copy():
+    """Decoded columns ALIAS the frame buffer: no per-block heap copy.
+    Proven two ways — np.shares_memory against the byte view, and a
+    mutation through the backing bytearray observed in the column."""
+    keys = np.arange(100, dtype=np.uint32)
+    vals = np.arange(100, dtype=np.float64) * 1.5
+    frame = bytearray(columnar.encode_columns([keys, vals]))
+    view = memoryview(frame)
+    cols = columnar.decode_columns(view)
+    base = np.frombuffer(view, dtype=np.uint8)
+    for col in cols:
+        assert np.shares_memory(col, base)
+    # mutate the first key's little-endian low byte through the buffer
+    off = columnar._COL.unpack_from(view, columnar._HDR.size)[1]
+    frame[off] = 0x7F
+    assert cols[0][0] == 0x7F  # the view observed it: same memory
+
+
+def test_nonconforming_batches_fall_back():
+    u = np.uint32(1)
+    assert columnar.encode_batch([]) is None
+    assert columnar.encode_batch([(1, 2)]) is None  # python ints
+    assert columnar.encode_batch([("k", u)]) is None  # string key
+    assert columnar.encode_batch([[u, u]]) is None  # list, not tuple
+    assert columnar.encode_batch([(u, u), (u,)]) is None  # ragged arity
+    assert columnar.encode_batch([(u,), (np.int64(1),)]) is None  # mixed
+    assert columnar.encode_batch([(np.str_("x"),)]) is None  # non-fixed
+    assert columnar.encode_batch([(u, np.int64(2))]) is not None
+
+
+def test_decode_rejects_corrupt_headers():
+    frame = bytearray(columnar.encode_columns([np.arange(8, dtype=np.uint32)]))
+    bad_magic = bytearray(frame)
+    bad_magic[0] ^= 0xFF
+    with pytest.raises(ValueError):
+        columnar.decode_columns(bad_magic)
+    bad_version = bytearray(frame)
+    bad_version[2] ^= 0xFF
+    with pytest.raises(ValueError):
+        columnar.decode_columns(bad_version)
+    truncated = frame[: columnar._HDR.size - 1]
+    with pytest.raises(ValueError):
+        columnar.decode_columns(bytes(truncated))
+
+
+def test_interleaved_frames_in_one_stream():
+    """Columnar and pickle frames interleave freely inside one block
+    stream; iter_compressed_blocks sniffs the magic per frame."""
+    import io
+
+    codec = CompressionCodec(enabled=True)
+    col_payload = columnar.encode_batch(
+        [(np.uint32(i), np.int64(i * 2)) for i in range(10)]
+    )
+    pkl_raw = b"".join(
+        struct.pack(">I", len(d)) + d
+        for d in (pickle.dumps(("k", i)) for i in range(3))
+    )
+    stream = io.BytesIO(
+        frame_columnar(col_payload)
+        + frame_compressed(codec, pkl_raw)
+        + frame_columnar(col_payload)
+    )
+    blocks = list(iter_compressed_blocks(stream, codec))
+    assert len(blocks) == 3
+    assert columnar.is_columnar(blocks[0])
+    assert not columnar.is_columnar(blocks[1])
+    assert columnar.is_columnar(blocks[2])
+    assert len(list(columnar.iter_records(blocks[0]))) == 10
+
+
+# ----------------------------------------------------------------------
+# wire extension (0xFFF9)
+# ----------------------------------------------------------------------
+def _mk_loc(pid, length, fmt=0):
+    return PartitionLocation(
+        ShuffleManagerId("host", 4321, f"exec-{pid % 2}"),
+        pid,
+        BlockLocation(pid * 64, length, 7, block_format=fmt),
+    )
+
+
+@pytest.mark.parametrize("seg_size", [4096, 256])
+def test_format_extension_roundtrip(seg_size):
+    from sparkrdma_tpu.rpc import PublishPartitionLocationsMsg, RpcMsg
+
+    locs = [
+        _mk_loc(p, 1000 + p, fmt=(BlockLocation.FORMAT_COLUMNAR if p % 3 else 0))
+        for p in range(40)
+    ]
+    msg = PublishPartitionLocationsMsg(5, -1, locs, num_map_outputs=1)
+    got = []
+    for seg in msg.to_segments(seg_size):
+        got.extend(RpcMsg.parse_segment(bytes(seg)).locations)
+    assert len(got) == len(locs)
+    for orig, back in zip(locs, got):
+        assert back.block.block_format == orig.block.block_format
+        assert back.block.is_columnar == (orig.block.block_format == 1)
+
+
+def test_format_extension_absent_keeps_legacy_bytes():
+    """All-pickle location sets emit NO 0xFFF9 group — frames are
+    byte-identical to pre-§25 builds."""
+    from sparkrdma_tpu.rpc import PublishPartitionLocationsMsg
+
+    locs = [_mk_loc(p, 500 + p) for p in range(10)]
+    msg = PublishPartitionLocationsMsg(5, -1, locs, num_map_outputs=1)
+    payload = b"".join(bytes(s) for s in msg.to_segments(1 << 20))
+    assert b"\xff\xf9" not in payload
+
+
+# ----------------------------------------------------------------------
+# writer negotiation
+# ----------------------------------------------------------------------
+def _np_records(n, num_keys=97):
+    return [
+        (np.uint32(i % num_keys), np.int64(i * 3)) for i in range(n)
+    ]
+
+
+def test_columnar_partition_writer_batches_and_fallback():
+    from sparkrdma_tpu.shuffle.writer.columnar import ColumnarPartitionWriter
+
+    out = []
+    codec = CompressionCodec(enabled=True)
+    w = ColumnarPartitionWriter(codec, out.append, batch_rows=8)
+    for rec in _np_records(20):
+        w.write_record(rec)
+    w.write_record(("python", "tuple"))  # poisons the tail batch
+    w.flush_batch()
+    assert w.columnar_frames == 2  # two full batches of 8
+    assert w.pickle_fallbacks == 1  # the mixed remainder
+    assert not w.all_columnar
+
+
+def test_sort_file_auto_negotiation(tmp_path):
+    from sparkrdma_tpu.shuffle.writer.sort_file import write_sorted_file
+
+    codec = CompressionCodec(enabled=True)
+    handle = BaseShuffleHandle(
+        shuffle_id=0, num_maps=1, partitioner=HashPartitioner(3)
+    )
+    # np-scalar tuples: auto engages columnar, every partition tagged
+    res = write_sorted_file(
+        iter(_np_records(1000)), handle, codec, str(tmp_path / "a.tmp"),
+        block_format="auto", batch_rows=64,
+    )
+    assert all(f == BlockLocation.FORMAT_COLUMNAR for f in res.formats)
+    assert res.columnar_frames > 0 and res.pickle_fallbacks == 0
+    assert all(n % 8 == 0 for n in res.lengths if n)
+    # python tuples: auto stays pickle, byte-identical to forced pickle
+    legacy = [(f"k{i % 7}", i) for i in range(500)]
+    res_auto = write_sorted_file(
+        iter(legacy), handle, codec, str(tmp_path / "b.tmp"),
+        block_format="auto",
+    )
+    res_pickle = write_sorted_file(
+        iter(legacy), handle, codec, str(tmp_path / "c.tmp"),
+        block_format="pickle",
+    )
+    assert res_auto.formats == [0, 0, 0]
+    assert res_auto.columnar_frames == 0
+    assert (tmp_path / "b.tmp").read_bytes() == (
+        tmp_path / "c.tmp"
+    ).read_bytes()
+
+
+# ----------------------------------------------------------------------
+# e2e byte identity: the same job under columnar and pickle
+# ----------------------------------------------------------------------
+def _run_cluster_shuffle(block_format, records_per_map=2000):
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "wrapper",
+            "tpu.shuffle.block.format": block_format,
+            "tpu.shuffle.block.columnarBatchRows": "256",
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="col-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="col-1")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=2, partitioner=HashPartitioner(3)
+        )
+        driver.register_shuffle(handle)
+        for map_id, ex in [(0, ex0), (1, ex1)]:
+            recs = [
+                (np.uint32((map_id * 7919 + i) % 997), np.int64(i))
+                for i in range(records_per_map)
+            ]
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(recs))
+            assert w.stop(True) is not None
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+        got = []
+        for ex, (lo, hi) in [(ex0, (0, 2)), (ex1, (2, 3))]:
+            got.extend(ex.get_reader(handle, lo, hi).read())
+        return got
+    finally:
+        ex1.stop()
+        ex0.stop()
+        driver.stop()
+
+
+def test_e2e_byte_identity_columnar_vs_pickle():
+    """Acceptance: the same shuffle under forced columnar and forced
+    pickle delivers byte-identical rows (values AND dtypes), and the
+    columnar run actually exercised the view-decode path."""
+    reg = get_registry()
+    before = reg.snapshot(prefix="block.")
+    rows_col = _run_cluster_shuffle("columnar")
+    delta = reg.delta(before, prefix="block.")
+    counters = delta.get("counters", {})
+    assert any(
+        k.startswith("block.view_decodes") and v > 0
+        for k, v in counters.items()
+    ), f"columnar run never hit the view-decode path: {counters}"
+    assert any(
+        k.startswith("block.columnar_blocks") and v > 0
+        for k, v in counters.items()
+    )
+    rows_pkl = _run_cluster_shuffle("pickle")
+    key = lambda r: (int(r[0]), int(r[1]))  # noqa: E731
+    rows_col.sort(key=key)
+    rows_pkl.sort(key=key)
+    assert len(rows_col) == len(rows_pkl) == 4000
+    for a, b in zip(rows_col, rows_pkl):
+        assert type(a[0]) is type(b[0]) and a[0] == b[0]
+        assert type(a[1]) is type(b[1]) and a[1] == b[1]
+    assert pickle.dumps(rows_col) == pickle.dumps(rows_pkl)
+
+
+# ----------------------------------------------------------------------
+# collective eligibility: ragged pickle vs padded columnar
+# ----------------------------------------------------------------------
+def test_ragged_stage_becomes_wave_eligible_under_columnar():
+    """Acceptance: a ragged stage (odd block lengths, as pickle payloads
+    produce) is 0% wave-eligible at a 4-byte elem dtype; the same stage
+    with columnar-padded lengths (every framed block a multiple of 8 by
+    construction) is >=90% eligible and compiles into DMA waves."""
+    from sparkrdma_tpu.shuffle import device_fetch as df
+    from sparkrdma_tpu.shuffle.collective import ShuffleScheduleCompiler
+    from sparkrdma_tpu.shuffle.device_io import DeviceShuffleIO
+
+    BLOCK = 64 << 10
+    conf = TpuShuffleConf({"tpu.shuffle.transport": "python"})
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex_map = TpuShuffleManager(conf, is_driver=False, executor_id="cb-map")
+    ex_red = TpuShuffleManager(conf, is_driver=False, executor_id="cb-red")
+    io_map, io_red = DeviceShuffleIO(ex_map), DeviceShuffleIO(ex_red)
+    lanes = [f"cb-lane-{i}" for i in range(3)]
+    for lane in lanes:
+        df.register_arena(lane, io_map.device_buffers)
+    try:
+        comp = ShuffleScheduleCompiler(conf, io_red.device_buffers, "cb-red")
+
+        def loc(pid, length, lane):
+            return PartitionLocation(
+                ShuffleManagerId("host", 1234, lane),
+                pid,
+                BlockLocation(
+                    0, length, 1, device_coords=0, arena_handle=1
+                ),
+            )
+
+        # ragged pickle stage: 12 blocks, every length odd
+        ragged = [
+            loc(p, BLOCK + 1 + 2 * i, lanes[i % 3])
+            for i in range(4)
+            for p in range(3)
+        ]
+        plan = comp.plan(ragged, dtype=np.uint32)
+        assert plan.device_blocks == 0
+        assert len(plan.passthrough) == len(ragged)
+        assert not plan.waves
+
+        # the same stage with columnar lengths: multiples of 8 (the
+        # codec's framing invariant, test_batch_roundtrip_property)
+        padded = [
+            loc(p, BLOCK + 8 * (1 + i), lanes[i % 3])
+            for i in range(4)
+            for p in range(3)
+        ]
+        plan = comp.plan(padded, dtype=np.uint32)
+        eligible_frac = plan.device_blocks / len(padded)
+        assert eligible_frac >= 0.9, (
+            f"only {plan.device_blocks}/{len(padded)} wave-eligible"
+        )
+        assert plan.waves
+        # uint64 elems too: columnar pads to 8, not just 4
+        assert comp.plan(padded, dtype=np.uint64).device_blocks == len(
+            padded
+        )
+    finally:
+        for lane in lanes:
+            df.unregister_arena(lane, io_map.device_buffers)
+        io_red.stop()
+        io_map.stop()
+        ex_red.stop()
+        ex_map.stop()
+        driver.stop()
+
+
+# ----------------------------------------------------------------------
+# device consume
+# ----------------------------------------------------------------------
+def test_device_put_columns_and_columnar_sort():
+    from sparkrdma_tpu.models.terasort import MapShardSorter
+    from sparkrdma_tpu.ops.sort import device_put_columns
+
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 2**32, 2048, dtype=np.uint32)
+    vals = np.arange(2048, dtype=np.int64)
+    frame = columnar.encode_columns([keys, vals])
+    cols = device_put_columns(frame)
+    assert len(cols) == 2
+    # (int64 narrows to int32 under jax's default x64-disabled config;
+    # the key column's uint32 survives exactly)
+    assert np.asarray(cols[0]).dtype == np.dtype(np.uint32)
+    np.testing.assert_array_equal(np.asarray(cols[0]), keys)
+    np.testing.assert_array_equal(np.asarray(cols[1]), vals)
+    sorter = MapShardSorter()
+    edges = np.asarray([1 << 30, 1 << 31, 3 << 30], dtype=np.uint32)
+    s1, b1 = sorter.sort_partition(keys, edges)
+    s2, b2 = sorter.sort_columnar_partition(frame, edges)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(b1, b2)

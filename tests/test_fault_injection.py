@@ -651,3 +651,71 @@ def test_push_corrupt_merged_segment_detected_then_fallback():
     )
     assert detected >= 1, "corruption fired but the checksum gate missed it"
     assert fallbacks >= 1, "detection without a fallback to the originals"
+
+
+def test_block_corrupt_header_detected_and_refetched():
+    """The ``block`` fault seam (DESIGN.md §25): one byte flipped inside
+    a landed columnar frame's header span, BEFORE the fetcher's checksum
+    gate runs. The gate must detect it (a corrupted dtype code or offset
+    table would mis-alias every zero-copy column view), the retry ladder
+    must refetch, and the reduce path must deliver byte-identical rows."""
+    import numpy as np
+
+    from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+    from sparkrdma_tpu.testing import faults
+
+    reg = get_registry()
+    before_detect = reg.snapshot(prefix="resilience.checksum_failures")
+    before_retry = reg.snapshot(prefix="resilience.retries")
+    conf = TpuShuffleConf(
+        {
+            "tpu.shuffle.shuffleWriteMethod": "wrapper",
+            "tpu.shuffle.block.format": "columnar",
+        }
+    )
+    driver = TpuShuffleManager(conf, is_driver=True)
+    ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="blk-0")
+    ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="blk-1")
+    try:
+        handle = BaseShuffleHandle(
+            shuffle_id=0, num_maps=2, partitioner=HashPartitioner(2)
+        )
+        driver.register_shuffle(handle)
+        expected = {}
+        for map_id, ex in [(0, ex0), (1, ex1)]:
+            recs = [
+                (np.uint32((map_id * 5000 + i) % 499), np.int64(i * 7))
+                for i in range(3000)
+            ]
+            for k, v in recs:
+                expected.setdefault(int(k), []).append(int(v))
+            w = ex.get_writer(handle, map_id)
+            w.write(iter(recs))
+            assert w.stop(True) is not None
+        ex0.finalize_maps(0)
+        ex1.finalize_maps(0)
+        got = {}
+        with faults.installed("block:corrupt_header:1", seed=17) as plan:
+            # ex0 reads both partitions: ex1's blocks arrive as remote
+            # one-sided READs into writable registered slices — the
+            # seam's target
+            for k, v in ex0.get_reader(handle, 0, 2).read():
+                got.setdefault(int(k), []).append(int(v))
+        assert plan.injected_count("block", "corrupt_header") == 1, (
+            "the columnar-header seam never fired — no writable "
+            "columnar frame reached the checksum gate"
+        )
+    finally:
+        ex1.stop()
+        ex0.stop()
+        driver.stop()
+    assert set(got) == set(expected)
+    for k in expected:
+        assert sorted(got[k]) == sorted(expected[k]), f"mismatch for key {k}"
+    detected = _counter_total(
+        reg.delta(before_detect, prefix="resilience.checksum_failures")
+    )
+    retries = _counter_total(reg.delta(before_retry, prefix="resilience.retries"))
+    assert detected >= 1, "header corruption fired but the gate missed it"
+    assert retries >= 1, "detection without a refetch"
