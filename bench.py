@@ -1525,6 +1525,143 @@ def bench_slo_overhead_ab(dry_run: bool = False) -> dict:
     }
 
 
+def bench_journal_overhead_ab(dry_run: bool = False) -> dict:
+    """Interleaved event-journal-off vs -on A/B on the SAME warm context
+    (obs/journal.py, docs/OBSERVABILITY.md "Event journal & capacity
+    plane").
+
+    Both sides run the same sequential job set on one TpuContext with
+    100 ms heartbeats; each job additionally drives a burst of emits
+    through the module-level seam so the measurement covers the full
+    plane — emit under lock, HLC tick, heartbeat shipping with one-beat
+    redundancy, and hub-side merge — not just the quiet steady state.
+    The "off" side flips :func:`journal.set_enabled`, which parks the
+    journal (seq continuity preserved) and reduces every emit site to a
+    module-global load + None check. The acceptance budget is ≤2%,
+    evaluated only when the interleaved pairs are stable enough to
+    resolve it (pair spread ≤ 4%); otherwise it SKIPS LOUDLY with
+    ``gate_skip_reason``, never a silent pass."""
+    from sparkrdma_tpu.engine.context import TpuContext
+    from sparkrdma_tpu.obs import get_registry
+    from sparkrdma_tpu.obs import journal as journal_mod
+    from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+    n_jobs = 2
+    n_rows = 2_000 if dry_run else 20_000
+    n_parts = 4
+    n_pairs = 2 if dry_run else 5
+    burst = 64  # emits per job through the module seam
+    reg = get_registry()
+    conf = TpuShuffleConf({
+        "tpu.shuffle.obs.profile.enabled": "false",
+        "tpu.shuffle.obs.telemetry.intervalMs": "100",
+    })
+
+    def journal_counter(name):
+        snap = reg.snapshot(prefix=name)
+        return sum(snap.get("counters", {}).values())
+
+    with TpuContext(num_executors=2, conf=conf, task_threads=2) as ctx:
+        hub = ctx.driver.telemetry
+        if hub is None:
+            raise SystemExit(
+                "BENCH FAILED: journal A/B needs driver telemetry"
+            )
+
+        def run_jobs():
+            for j in range(n_jobs):
+                mod = 4093 + j
+                rdd = (
+                    ctx.parallelize(range(n_rows), n_parts)
+                    .map(lambda x, m=mod: (x % m, x))
+                    .reduce_by_key(lambda a, b: a + b,
+                                   num_partitions=n_parts)
+                )
+                # incident-storm sized burst at a real emit site shape:
+                # a no-op on the off side, the full ring/ship/merge
+                # plane on the on side
+                for i in range(burst):
+                    journal_mod.emit("bench.tick", role="bench", beat=i)
+                if not ctx.run_job(rdd):
+                    raise SystemExit(
+                        "BENCH FAILED: journal A/B job returned nothing"
+                    )
+
+        def bytes_written():
+            snap = reg.snapshot(prefix="writer.bytes_written")
+            return sum(snap.get("counters", {}).values())
+
+        def one_side(enabled):
+            journal_mod.set_enabled(enabled)
+            b0 = bytes_written()
+            t0 = time.perf_counter()
+            try:
+                run_jobs()
+            finally:
+                journal_mod.set_enabled(True)
+            return (bytes_written() - b0) / (time.perf_counter() - t0) / 1e6
+
+        run_jobs()  # warm: executors, pools, codecs
+        ev0 = journal_counter("journal.events")
+        mg0 = journal_counter("journal.merged")
+        pairs = []
+        for _ in range(n_pairs):
+            a = one_side(False)
+            b = one_side(True)
+            pairs.append({"off_mbps": round(a, 3), "on_mbps": round(b, 3)})
+        events = int(journal_counter("journal.events") - ev0)
+        merged = int(journal_counter("journal.merged") - mg0)
+    med_a = float(np.median([p["off_mbps"] for p in pairs]))
+    med_b = float(np.median([p["on_mbps"] for p in pairs]))
+    overhead_pct = round((1.0 - med_b / med_a) * 100.0, 3) if med_a else None
+    ratios = [p["on_mbps"] / p["off_mbps"] for p in pairs if p["off_mbps"]]
+    pair_spread_pct = (
+        round((max(ratios) - min(ratios)) * 100.0, 3) if ratios else None
+    )
+    gate_evaluated = (
+        not dry_run
+        and overhead_pct is not None
+        and pair_spread_pct is not None
+        and pair_spread_pct <= 4.0
+        and events > 0
+    )
+    gate_skip_reason = None
+    if not gate_evaluated:
+        if dry_run:
+            gate_skip_reason = (
+                "dry run: volume too small to resolve a 2% delta"
+            )
+        elif events == 0:
+            gate_skip_reason = "journal recorded zero events on the on side"
+        elif pair_spread_pct is None or overhead_pct is None:
+            gate_skip_reason = "no throughput measured"
+        else:
+            gate_skip_reason = (
+                f"pair spread {pair_spread_pct}% > 4%: run too noisy to "
+                "resolve a 2% overhead budget"
+            )
+    if gate_evaluated and overhead_pct > 2.0:
+        raise SystemExit(
+            f"BENCH FAILED: event journal overhead {overhead_pct}% > 2% "
+            f"(off {med_a:.1f} MB/s, on {med_b:.1f} MB/s, "
+            f"{events} events emitted)"
+        )
+    return {
+        "ab_journal_overhead": {
+            "pairs": pairs,
+            "off_mbps": round(med_a, 3),
+            "on_mbps": round(med_b, 3),
+            "overhead_pct": overhead_pct,
+            "pair_spread_pct": pair_spread_pct,
+            "journal_events": events,
+            "journal_merged": merged,
+            "burst_per_job": burst,
+            "gate_evaluated": gate_evaluated,
+            "gate_skip_reason": gate_skip_reason,
+        }
+    }
+
+
 def _is_tpu() -> bool:
     try:
         from sparkrdma_tpu.ops.remote_copy import is_tpu_mesh
@@ -2000,7 +2137,7 @@ def main() -> None:
         default="",
         choices=["", "device_fetch", "concurrent_jobs", "iouring_read",
                  "consume_sharded", "profiler_overhead", "slo_overhead",
-                 "columnar_decode"],
+                 "journal_overhead", "columnar_decode"],
         help="run ONE A/B at reduced volume and print its JSON — the CI "
         "obs smoke's dry-run mode (e.g. --ab device_fetch)",
     )
@@ -2012,6 +2149,7 @@ def main() -> None:
         "consume_sharded": bench_consume_sharded_ab,
         "profiler_overhead": bench_profiler_overhead_ab,
         "slo_overhead": bench_slo_overhead_ab,
+        "journal_overhead": bench_journal_overhead_ab,
         "columnar_decode": bench_columnar_decode_ab,
     }
     if args.ab:
@@ -2050,6 +2188,7 @@ def main() -> None:
     out.update(bench_concurrent_jobs_ab())
     out.update(bench_profiler_overhead_ab())
     out.update(bench_slo_overhead_ab())
+    out.update(bench_journal_overhead_ab())
     out.update(bench_columnar_decode_ab())
     import jax
 

@@ -24,7 +24,15 @@ obs registry for the serving invariants:
 - **push-vs-rpc probe** — a short cluster-mode (subprocess workers)
   segment under concurrent two-tenant load, verifying push volume
   moves on the data plane and NEVER shows up as an ``rpc.handle_ms``
-  message type (recorded either way).
+  message type (recorded either way);
+- **event journal + capacity** (PR 20) — the merged HLC-ordered
+  cluster event journal rides the ledger as ``ledger["journal"]``
+  (render with ``python -m sparkrdma_tpu.obs --timeline LEDGER``) and
+  the USE-method capacity report as ``ledger["capacity"]``. A quiet
+  soak gates on a quiet journal (no pages, no takeovers); the quota
+  probe gates on the capacity plane naming mempool as the binding
+  resource; ``driver:kill`` chaos gates on the journal reproducing
+  the kill -> takeover -> adoption causal chain in merged HLC order.
 
 Since PR 16 the verdicts are built on the SLO engine's shared
 :func:`~sparkrdma_tpu.obs.slo.judge` primitive (soak and production
@@ -265,8 +273,15 @@ def run_soak(args) -> dict:
         if hub is not None:
             hub.slo.evaluate()
             slo_summary = hub.slo.summary()
+            # PR 20 artifacts: the merged HLC-ordered event journal (the
+            # incident timeline, rendered by `python -m sparkrdma_tpu.obs
+            # --timeline LEDGER`) and the USE-method capacity report
+            journal_events = hub.journal.merged()
+            capacity_report = hub.capacity.capacity_report(refresh=True)
         else:
             slo_summary = {}
+            journal_events = []
+            capacity_report = {}
 
     # ---- per-tenant ledger -------------------------------------------
     total_secs = 0.0
@@ -320,6 +335,8 @@ def run_soak(args) -> dict:
             if k.startswith("metastore.")
         },
         "slo": slo_summary,
+        "journal": journal_events,
+        "capacity": capacity_report,
     }
 
 
@@ -381,6 +398,16 @@ def run_quota_probe(args) -> dict:
             hog_t.start()
             time.sleep(0.5)  # let the hog hit its quota first
             contended = quiet_jobs(ctx, 5)
+            # USE-method capacity report captured WHILE the hog is still
+            # pinned at its quota: the binding resource must be the
+            # quota-governed mempool, with every other resource showing
+            # more headroom (docs/OBSERVABILITY.md "Event journal &
+            # capacity plane")
+            hub = ctx.driver.telemetry
+            capacity = (
+                hub.capacity.capacity_report(refresh=True)
+                if hub is not None else {}
+            )
             stop.set()
             hog_t.join(timeout=120)
     finally:
@@ -401,6 +428,7 @@ def run_quota_probe(args) -> dict:
         "hog_quota_blocks": blocks,
         "hog_quota_overruns": overruns,
         "hog_jobs_completed": hog_jobs["n"],
+        "capacity": capacity,
     }
 
 
@@ -533,6 +561,10 @@ def main() -> int:
     }
     ledger["soak"] = run_soak(args)
     ledger["slo"] = ledger["soak"].pop("slo", {})
+    # top level so `python -m sparkrdma_tpu.obs --timeline LEDGER` finds
+    # the merged event journal directly
+    ledger["journal"] = ledger["soak"].pop("journal", [])
+    ledger["capacity"] = ledger["soak"].pop("capacity", {})
     chaos_mode = bool(args.fault_plan)
     if not chaos_mode:
         ledger["quota_probe"] = run_quota_probe(args)
@@ -581,6 +613,16 @@ def main() -> int:
                 ledger["quota_probe"]["hog_jobs_completed"]),
             1, "ge",
             note="hog must both block on quota and keep progressing"))
+        # USE-plane capacity gate: under quota backpressure the report
+        # must name the quota-governed mempool as THE binding resource
+        # (argmax utilization — every other resource shows more headroom)
+        binding = (ledger["quota_probe"].get("capacity") or {}).get(
+            "binding") or {}
+        check("capacity_binding_is_mempool", judge(
+            "capacity-binding-is-mempool",
+            int(binding.get("resource") == "mempool"), 1, "eq",
+            note=f"binding={binding.get('resource', 'none')} "
+                 f"headroom={binding.get('headroom', 'n/a')}"))
     probe = ledger.get("push_rpc_probe", {})
     if "error" not in probe and probe:
         check("push_absent_from_rpc_handle_ms", judge(
@@ -616,6 +658,15 @@ def main() -> int:
             note="healthy soak must not page"))
         check("zero_diagnoses", judge(
             "zero-diagnoses", len(diagnoses), 0, "eq"))
+        # quiet-journal gate: a healthy soak's merged event journal must
+        # carry no pages and no lease takeovers
+        noisy = sum(
+            1 for e in ledger["journal"]
+            if e.get("kind") in ("slo.page", "meta.takeover")
+        )
+        check("journal_quiet", judge(
+            "journal-quiet", noisy, 0, "eq",
+            note="no slo.page / meta.takeover events in a healthy soak"))
     # ---- control-plane HA gate: driver killed mid-job -----------------
     # (docs/RESILIENCE.md "Control-plane HA"): the metadata hub was
     # wiped while jobs were in flight, so on top of the zero-failure
@@ -630,6 +681,23 @@ def main() -> int:
             "driver-kill-readopted", adoptions, 1, "ge",
             note="post-wipe publishes carrying the new generation must "
                  "land as adoptions, not recomputes"))
+        # causal-order gate: the merged HLC order must reproduce the
+        # incident chain kill -> takeover -> adoption (the journal is
+        # already sorted by (hlc, origin, seq))
+        kinds = [e.get("kind") for e in ledger["journal"]]
+        order_ok = 0
+        if "driver.kill" in kinds:
+            ki = kinds.index("driver.kill")
+            ti = next((i for i in range(ki + 1, len(kinds))
+                       if kinds[i] == "meta.takeover"), -1)
+            if ti > ki:
+                ai = next((i for i in range(ti + 1, len(kinds))
+                           if kinds[i] == "meta.adopt"), -1)
+                order_ok = int(ai > ti)
+        check("journal_kill_takeover_adopt_order", judge(
+            "journal-kill-takeover-adopt-order", order_ok, 1, "eq",
+            note="merged journal HLC order must show driver.kill before "
+                 "meta.takeover before meta.adopt"))
     if args.strict:
         check("fairness_within_25pct", judge(
             "fairness-within-25pct", soak["fairness_max_rel_dev"],

@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 
 logger = logging.getLogger(__name__)
 
@@ -174,6 +175,13 @@ class SpeculativeReducePhase:
                     done[idx] = fut.result()
                     if clone:
                         self._m_wins.inc()
+                        journal_emit(
+                            "elastic.spec_win",
+                            role=self._driver.executor_id,
+                            executor=worker.executor_id,
+                            shuffle_id=self._handle.shuffle_id,
+                            range=list(rngs[idx]),
+                        )
                     losers = list(flight.values())
                     flight.clear()
                 elif not flight:
@@ -222,6 +230,13 @@ class SpeculativeReducePhase:
                         clones.append((idx, peer))
             for idx, worker in clones:
                 self._m_specs.inc()
+                journal_emit(
+                    "elastic.spec", role=self._driver.executor_id,
+                    executor=worker.executor_id,
+                    tenant=self._tenant or "",
+                    shuffle_id=self._handle.shuffle_id,
+                    range=list(rngs[idx]),
+                )
                 logger.warning(
                     "speculating reduce range %s: cloning off flagged "
                     "executor onto %s", rngs[idx], worker.executor_id,

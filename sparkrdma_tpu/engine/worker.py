@@ -85,6 +85,12 @@ class Worker:
         # on a timer; the driver pulls them with {"kind": "telemetry"}
         self.heartbeater = None
         if conf.telemetry_enabled:
+            # arm the process event journal: this worker's control-plane
+            # transitions (circuit trips, quota blocks) ride the
+            # heartbeat payloads below into the driver's merged journal
+            from sparkrdma_tpu.obs import journal as _journal
+
+            _journal.configure(conf, role=executor_id)
             self.heartbeater = Heartbeater(
                 get_registry(),
                 executor_id,

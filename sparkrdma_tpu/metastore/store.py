@@ -45,6 +45,7 @@ from sparkrdma_tpu.locations import PartitionLocation
 from sparkrdma_tpu.metastore.lease import LeaseTable, StaleEpochError
 from sparkrdma_tpu.metastore.shardmap import ShardMap
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 from sparkrdma_tpu.resilience.retry import RetryPolicy
 from sparkrdma_tpu.testing import faults as _faults
 
@@ -139,6 +140,10 @@ class ShardedMetaStore:
                         self._reg.counter(
                             "metastore.lease_takeovers", role=self.role
                         ).inc()
+                        journal_emit(
+                            "meta.takeover", role=self.role,
+                            peer=peer, epoch=epoch,
+                        )
                     else:
                         epoch = self._leases.epoch(peer)
                     routed.append((peer, epoch))
@@ -360,6 +365,10 @@ class ShardedMetaStore:
             self._reg.gauge("metastore.shards", role=self.role).set(
                 len(self._ring.peers))
             self._reg.counter("metastore.peer_kills", role=self.role).inc()
+            journal_emit(
+                "meta.peer_kill", role=self.role, peer=peer,
+                generation=self.generation,
+            )
         shard = self._shards[peer]
         with shard.lock:
             shard.alive = False
@@ -378,12 +387,22 @@ class ShardedMetaStore:
         with self._topology:
             self.generation += 1
             self._leases.bump_all()
+            journal_emit(
+                "meta.epoch_bump", role=self.role,
+                generation=self.generation,
+            )
             for peer in self._ring.peers:
                 epoch = self._leases.epoch(peer)
                 shard = self._shards[peer]
                 with shard.lock:
                     shard.entries.clear()
                     shard.epoch = epoch
+                # every lease re-granted under the bumped epoch is a
+                # takeover of that peer's slice — journaled per peer so
+                # the chaos timeline shows kill -> takeover -> adopt
+                journal_emit(
+                    "meta.takeover", role=self.role, peer=peer, epoch=epoch,
+                )
             self._reg.gauge("metastore.epoch", role=self.role).set(
                 self.generation)
             return self.generation

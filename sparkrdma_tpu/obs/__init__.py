@@ -7,11 +7,23 @@ rings, straggler detection, OpenMetrics export, flight recorder).
 ``python -m sparkrdma_tpu.obs`` dumps the registry.
 """
 
+from sparkrdma_tpu.obs.capacity import CapacityPlane
 from sparkrdma_tpu.obs.export import (
     OpenMetricsServer,
     extract_snapshot,
     render_openmetrics,
     write_openmetrics,
+)
+from sparkrdma_tpu.obs.journal import (
+    HLC,
+    EventJournal,
+    JournalHub,
+    active_journal,
+    emit,
+    events_to_chrome,
+    extract_events,
+    get_journal,
+    render_timeline,
 )
 from sparkrdma_tpu.obs.metrics import (
     Counter,
@@ -60,10 +72,14 @@ from sparkrdma_tpu.obs.trace import (
 
 __all__ = [
     "Breach",
+    "CapacityPlane",
     "Counter",
+    "EventJournal",
     "Gauge",
+    "HLC",
     "Heartbeater",
     "Histogram",
+    "JournalHub",
     "MetricsRegistry",
     "Objective",
     "OpenMetricsServer",
@@ -77,14 +93,19 @@ __all__ = [
     "Tracer",
     "Window",
     "acquire_profiler",
+    "active_journal",
     "all_tracers",
     "build_diagnosis",
     "burn_rate",
     "collect_spans",
     "collect_spans_with_epochs",
+    "emit",
+    "events_to_chrome",
     "exceedance",
     "export_chrome_trace",
+    "extract_events",
     "extract_snapshot",
+    "get_journal",
     "get_profiler",
     "get_registry",
     "get_tracer",
@@ -98,6 +119,7 @@ __all__ = [
     "render_diagnosis",
     "render_flamegraph_html",
     "render_openmetrics",
+    "render_timeline",
     "snapshot_delta",
     "strip_label",
     "to_chrome_trace",

@@ -19,6 +19,9 @@ or prints the ``breakdown`` stored in a bench/flight artifact.
 ``--diagnose FILE`` renders SLO breach diagnoses (obs/diagnose.py)
 from a standalone diagnosis artifact, a flight record's ``slo``
 section, or a soak ledger's ``slo.diagnosis_records``.
+``--timeline FILE`` renders the causally-ordered incident timeline
+(obs/journal.py) from any artifact carrying journal events — a flight
+record, a soak ledger, a live snapshot, or a bare event list.
 
 Continuous profiling (obs/profiler.py): ``--demo`` runs under the
 default sampling profiler, and ``--flamegraph [DEST]`` /
@@ -43,6 +46,10 @@ from sparkrdma_tpu.obs.profiler import ProfileHub
 
 
 def _run_demo() -> "ProfileHub":
+    from sparkrdma_tpu.obs import journal as journal_mod
+    from sparkrdma_tpu.obs.capacity import CapacityPlane
+    from sparkrdma_tpu.obs.journal import render_timeline
+    from sparkrdma_tpu.obs.metrics import get_registry as _get_registry
     from sparkrdma_tpu.obs.profiler import acquire_profiler, release_profiler
     from sparkrdma_tpu.shuffle.handle import BaseShuffleHandle, HashPartitioner
     from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
@@ -59,6 +66,9 @@ def _run_demo() -> "ProfileHub":
         }
     )
     profiler = acquire_profiler(conf, role="proc")
+    # arm the event journal before the shuffle so every control-plane
+    # transition site that fires lands in the demo timeline
+    journal_mod.configure(conf, role="proc")
     driver = TpuShuffleManager(conf, is_driver=True)
     ex0 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-0")
     ex1 = TpuShuffleManager(conf, is_driver=False, executor_id="exec-1")
@@ -83,6 +93,23 @@ def _run_demo() -> "ProfileHub":
         ex0.stop()
         ex1.stop()
         driver.stop()
+    # exercise the PR-20 planes: one USE evaluation (capacity.* gauges
+    # land in the registry dump below) and the incident timeline —
+    # stderr only, the stdout contract is still pure JSON
+    cap = CapacityPlane(conf, _get_registry(), role="proc")
+    cap.evaluate()
+    rep = cap.capacity_report(refresh=False)
+    binding = rep.get("binding") or {}
+    if binding:
+        print(
+            f"capacity: binding={binding.get('resource')} "
+            f"headroom={binding.get('headroom', 1.0):.0%} over "
+            f"{len(rep.get('resources', {}))} resources",
+            file=sys.stderr,
+        )
+    j = journal_mod.active_journal()
+    if j is not None and j.events():
+        print(render_timeline(j.events(), limit=20), file=sys.stderr)
     hub = ProfileHub()
     hub.ingest_local(profiler, "proc")
     release_profiler(profiler)
@@ -168,6 +195,23 @@ def _print_diagnosis(path: str) -> int:
     for diag in diagnoses:
         print()
         print(render(diag))
+    return 0
+
+
+def _print_timeline(path: str) -> int:
+    """Render the causally-ordered incident timeline from any artifact
+    carrying journal events (obs/journal.py)."""
+    from sparkrdma_tpu.obs.journal import extract_events, render_timeline
+
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    events = extract_events(doc)
+    if not events:
+        print(f"{path}: no journal events found (expected a flight "
+              "record, soak ledger, snapshot with a 'journal' key, or "
+              "a bare event list)", file=sys.stderr)
+        return 2
+    print(render_timeline(events))
     return 0
 
 
@@ -276,6 +320,12 @@ def main(argv=None) -> int:
         "flight record, or a soak ledger with an 'slo' section, then exit",
     )
     ap.add_argument(
+        "--timeline", default=None, metavar="FILE",
+        help="render the causally-ordered journal event timeline from a "
+        "flight record, soak ledger, snapshot, or bare event list, then "
+        "exit",
+    )
+    ap.add_argument(
         "--flamegraph", nargs="?", const="-", default=None, metavar="DEST",
         help="render the merged profile samples (from --demo, or the "
         "profile windows of a flight record given via --from-snapshot) as "
@@ -294,6 +344,8 @@ def main(argv=None) -> int:
         return _print_critical_path(args.critical_path)
     if args.diagnose:
         return _print_diagnosis(args.diagnose)
+    if args.timeline:
+        return _print_timeline(args.timeline)
     hub = None
     if args.demo:
         hub = _run_demo()

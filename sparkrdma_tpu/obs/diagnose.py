@@ -36,9 +36,19 @@ dead-metastore-peer     3.25 metastore.peer_kills / lease takeovers
 straggler               3.0  robust-z straggler report (PR 5)
 circuit-open            2.5  resilience SourceHealthRegistry states
 quota-backpressure      2.0  tenant.quota_blocks counters (PR 13)
+saturated-resource      1.75 capacity plane binding resource (PR 20)
 dominant-category       1.5  TimeBreakdown critical path (PR 14)
 trend-regression        1.0  ledger deltas vs committed trend (PR 15)
 ====================  =====  ==========================================
+
+Two PR-20 evidence sources feed the walk without new top-level cause
+machinery: the merged cluster **event journal** (time-windowed around
+the breach) names executors for the corroboration bonus and backfills
+ranked causes when a live metric source is silent (e.g. the breaker
+already closed but ``circuit.open`` is in the journal), and the
+**capacity plane**'s USE report contributes the ``saturated-resource``
+row when the binding resource shows saturation, errors, or
+near-exhausted headroom.
 
 An injected fault outranks everything because it is the one cause we
 *know* is real; infrastructure evidence (dead executor, straggler)
@@ -76,6 +86,10 @@ RUBRIC: Dict[str, float] = {
     "straggler": 3.0,
     "circuit-open": 2.5,
     "quota-backpressure": 2.0,
+    # the USE-method binding resource: symptom-adjacent (the resource
+    # is saturated *because* of load) but more actionable than the raw
+    # dominant category — it names the knob to turn
+    "saturated-resource": 1.75,
     "dominant-category": 1.5,
     "trend-regression": 1.0,
 }
@@ -83,6 +97,25 @@ RUBRIC: Dict[str, float] = {
 # Added when a cause's executor is independently named by the breach
 # itself or by a second evidence source.
 CORROBORATION_BONUS = 0.5
+
+# Journal event kinds that can stand in for a live evidence source when
+# the transient already resolved (breaker closed, tenant unblocked, peer
+# re-adopted) by the time the diagnosis runs. Maps event kind -> RUBRIC
+# cause class.
+JOURNAL_CAUSE_KINDS: Dict[str, str] = {
+    "driver.kill": "dead-metastore-peer",
+    "meta.peer_kill": "dead-metastore-peer",
+    "meta.takeover": "dead-metastore-peer",
+    "circuit.open": "circuit-open",
+    "straggler.flag": "straggler",
+    "quota.block": "quota-backpressure",
+}
+
+# How far around the breach instant journal events count as evidence:
+# everything in the half-minute leading up to it (causes precede
+# symptoms) plus a short tail for events that race the breach emit.
+JOURNAL_WINDOW_BEFORE_MS = 30_000
+JOURNAL_WINDOW_AFTER_MS = 5_000
 
 
 def _fault_evidence() -> dict:
@@ -153,6 +186,34 @@ def _metastore_evidence(registry: MetricsRegistry) -> Dict[str, int]:
     return out
 
 
+def _journal_evidence(hub, breach_wall_ms) -> List[dict]:
+    """Merged journal events time-windowed around the breach.
+
+    Falls back to the journal tail when the window is empty (clock skew
+    between emitters and the breach stamp must not erase evidence)."""
+    journal = getattr(hub, "journal", None)
+    if journal is None:
+        return []
+    merged = journal.merged(last=256)
+    if not merged:
+        return []
+    if breach_wall_ms:
+        lo = breach_wall_ms - JOURNAL_WINDOW_BEFORE_MS
+        hi = breach_wall_ms + JOURNAL_WINDOW_AFTER_MS
+        windowed = [e for e in merged
+                    if lo <= e.get("wall_ms", 0) <= hi]
+        if windowed:
+            return windowed
+    return merged[-64:]
+
+
+def _capacity_evidence(hub) -> dict:
+    plane = getattr(hub, "capacity", None)
+    if plane is None:
+        return {}
+    return plane.capacity_report(refresh=True)
+
+
 def _trend_evidence(trend_dir: Optional[str]) -> dict:
     if not trend_dir:
         return {}
@@ -216,6 +277,9 @@ def build_diagnosis(
     missed = probe(lambda: list(hub.missed_executors()), [])
     quota = probe(lambda: _quota_evidence(reg), {})
     metastore = probe(lambda: _metastore_evidence(reg), {})
+    journal_events = probe(
+        lambda: _journal_evidence(hub, breach_d.get("wall_ms")), [])
+    capacity = probe(lambda: _capacity_evidence(hub), {}) or {}
     trend = probe(lambda: _trend_evidence(trend_dir), {})
     dominant = _dominant_category(breakdown)
     gap_frames = list(breakdown.get("gap_frames", []))[:5]
@@ -239,6 +303,8 @@ def build_diagnosis(
     for key in open_circuits:
         # breaker keys are "<executor>" or "<tenant>:<executor>"
         name_executor(key.rpartition(":")[2], "circuit")
+    for ev in journal_events:
+        name_executor(ev.get("executor", ""), "journal")
     if breach_exec:
         name_executor(breach_exec, "breach")
 
@@ -320,6 +386,29 @@ def build_diagnosis(
         if breach_tenant and tenant == breach_tenant:
             cause_detail["matches_breach_tenant"] = 1
         add_cause("quota-backpressure", summary, detail=cause_detail)
+    binding = capacity.get("binding") or {}
+    if binding and (
+        binding.get("saturation", 0) > 0
+        or binding.get("errors", 0) > 0
+        or (binding.get("utilization") or 0.0) >= 0.9
+    ):
+        util = binding.get("utilization") or 0.0
+        add_cause(
+            "saturated-resource",
+            f"binding resource {binding.get('resource', '?')} at "
+            f"{util:.0%} utilization (headroom "
+            f"{binding.get('headroom', 1.0):.0%}, saturation "
+            f"{binding.get('saturation', 0)}, errors "
+            f"{binding.get('errors', 0)})",
+            source="capacity",
+            detail={
+                "resource": [binding.get("resource", "")],
+                "utilization": round(util, 4),
+                "headroom": round(binding.get("headroom", 1.0), 4),
+                "saturation": binding.get("saturation", 0),
+                "errors": binding.get("errors", 0),
+            },
+        )
     if dominant is not None:
         add_cause(
             "dominant-category",
@@ -333,6 +422,37 @@ def build_diagnosis(
             "trend-regression",
             f"committed-trend regression on {name}",
             detail={"series": [name]},
+        )
+
+    # Journal evidence per cause class: when a live metric source
+    # already produced the cause, the windowed events attach to it as
+    # corroborating detail; when the transient resolved before the
+    # diagnosis ran (breaker closed, peer re-adopted), the journal
+    # events BECOME the ranked cause — the journal remembers what the
+    # point-in-time probes no longer see.
+    journal_grouped: Dict[tuple, List[dict]] = {}
+    for ev in journal_events:
+        kind = JOURNAL_CAUSE_KINDS.get(ev.get("kind", ""))
+        if kind is None:
+            continue
+        key = (kind, ev.get("executor", ""))
+        journal_grouped.setdefault(key, []).append(ev)
+    for (kind, eid), evs in sorted(journal_grouped.items()):
+        existing = next(
+            (c for c in causes
+             if c["cause"] == kind and c["executor"] == eid), None)
+        if existing is not None:
+            existing["detail"]["journal_events"] = evs[-3:]
+            continue
+        last = evs[-1]
+        hlc = last.get("hlc") or [0, 0]
+        add_cause(
+            kind,
+            f"journal: {len(evs)}x {last.get('kind', '?')}"
+            + (f" on {eid}" if eid else "")
+            + f" (last hlc=({hlc[0]},{hlc[1]}))",
+            executor=eid, source="journal",
+            detail={"events": evs[-3:], "count": len(evs)},
         )
 
     causes.sort(key=lambda c: (-c["score"], c["cause"], c["executor"]))
@@ -357,6 +477,8 @@ def build_diagnosis(
             "missed_heartbeats": missed,
             "quota_blocks": quota,
             "metastore": metastore,
+            "journal": journal_events[-16:],
+            "capacity": capacity,
             "trend": trend,
         },
         "causes": causes,
@@ -418,6 +540,15 @@ def render(diag: dict) -> str:
         out.append("  profiler gap frames:")
         for g in gaps[:3]:
             out.append(f"    {g}")
+    binding = (ev.get("capacity") or {}).get("binding") or {}
+    if binding:
+        out.append(
+            f"  capacity   binding={binding.get('resource', '?')} "
+            f"headroom={binding.get('headroom', 1.0):.0%}"
+        )
+    jev = ev.get("journal", [])
+    if jev:
+        out.append(f"  journal    {len(jev)} event(s) in breach window")
     return "\n".join(out)
 
 

@@ -175,6 +175,18 @@ METRIC_FAMILIES: Dict[str, Tuple[str, frozenset]] = {
     "rpc.messages": ("counter", _L({"role", "type"})),
     "rpc.errors": ("counter", _L({"role"})),
     "rpc.handle_ms": ("histogram", _L({"role", "type"})),
+    # cluster event journal (obs/journal.py)
+    "journal.events": ("counter", _L({"role"})),
+    "journal.merged": ("counter", _L({"role"})),
+    "journal.duplicates": ("counter", _L({"role"})),
+    "journal.gaps": ("counter", _L({"role"})),
+    "journal.size": ("gauge", _L({"role"})),
+    # USE-method capacity plane (obs/capacity.py)
+    "capacity.evaluations": ("counter", _L({"role"})),
+    "capacity.utilization": ("gauge", _L({"resource"})),
+    "capacity.saturation": ("gauge", _L({"resource"})),
+    "capacity.errors": ("gauge", _L({"resource"})),
+    "capacity.binding_headroom": ("gauge", _L({"role"})),
     # SLO engine + automated diagnosis (obs/slo.py, obs/diagnose.py)
     "slo.evaluations": ("counter", _L({"role"})),
     "slo.objectives": ("gauge", _L({"role"})),

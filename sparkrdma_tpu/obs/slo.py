@@ -68,6 +68,7 @@ from typing import (
     Tuple,
 )
 
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 from sparkrdma_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
@@ -607,6 +608,12 @@ class SLOEngine:
             prev = self._breaching.get(key)
             if severity is None:
                 self._breaching.pop(key, None)
+                if prev is not None:
+                    journal_emit(
+                        "slo.recover", role=self.role, executor=executor,
+                        tenant=obj.tenant or "", wall_ms=now_ms,
+                        objective=obj.name, was=prev,
+                    )
                 return []
             # re-record only on a fresh breach or a warn->page escalation
             if prev == severity or (prev == "page" and severity == "warn"):
@@ -615,6 +622,11 @@ class SLOEngine:
                 )
                 return []
             self._breaching[key] = severity
+        journal_emit(
+            f"slo.{severity}", role=self.role, executor=executor,
+            tenant=obj.tenant or "", wall_ms=now_ms,
+            objective=obj.name,
+        )
         breach = Breach(
             objective=obj.name,
             kind=obj.kind,

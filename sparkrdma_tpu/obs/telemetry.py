@@ -47,6 +47,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Mapping, Optional
 
+from sparkrdma_tpu.obs import journal as _journal
 from sparkrdma_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
@@ -152,6 +153,13 @@ class Heartbeater:
         self._paused = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # event-journal shipping state: cursor into the process journal
+        # plus the previous beat's batch (one-beat redundancy). The
+        # journal is resolved per beat (active_journal) so a journal
+        # configured after this heartbeater starts still ships.
+        self._journal_override: Optional[_journal.EventJournal] = None
+        self._journal_cursor = 0
+        self._journal_prev: List[dict] = []
 
     def beat(self) -> Optional[dict]:
         """One sample: delta vs the moving baseline, then advance it."""
@@ -192,6 +200,21 @@ class Heartbeater:
             profile = self._profiler.drain()
             if profile:
                 payload["profile"] = profile
+        # event-journal piggyback: heartbeats are the causality-carrying
+        # messages of the journal's HLC protocol. Each beat ships the
+        # PREVIOUS beat's batch again alongside the new events (one-beat
+        # redundancy), so a single lost heartbeat loses nothing and the
+        # hub's (origin, seq) dedupe folds the overlap to one copy.
+        j = self._journal_override or _journal.active_journal()
+        if j is not None:
+            with self._lock:
+                fresh = j.events_since(self._journal_cursor)
+                if fresh:
+                    self._journal_cursor = fresh[-1]["seq"]
+                batch = self._journal_prev + fresh
+                self._journal_prev = fresh
+            if batch:
+                payload["journal"] = batch
         if self._send is not None:
             try:
                 self._send(payload)
@@ -214,6 +237,12 @@ class Heartbeater:
         """Piggyback a sampling profiler's drained collapsed-stack
         table onto every subsequent beat (``payload["profile"]``)."""
         self._profiler = profiler
+
+    def attach_journal(self, journal) -> None:
+        """Ship this journal's events instead of the process journal
+        (tests / explicit wiring); None reverts to per-beat
+        ``active_journal()`` resolution."""
+        self._journal_override = journal
 
     def pause(self) -> None:
         with self._lock:
@@ -343,6 +372,34 @@ class TelemetryHub:
         # cluster-wide merge of the executors' collapsed-stack profile
         # tables (heartbeat "profile" payloads, obs/profiler.py)
         self.profiles = ProfileHub(clock=clock)
+        # cluster event journal: configure this process's journal from
+        # conf (the driver-side transitions emit into it) and merge the
+        # heartbeat-shipped batches into one causally-ordered record
+        self.journal_flight_events = int(
+            conf.journal_flight_events if conf is not None else 64
+        )
+        _journal.configure(conf, role=role, registry=self._registry,
+                           clock=clock)
+        journal_ring = int(
+            conf.journal_ring_size if conf is not None else 512
+        )
+        self.journal = _journal.JournalHub(
+            self._registry, role=role, ring_size=journal_ring * 4,
+            clock=clock,
+        )
+        # USE-method capacity plane: evaluated on the ingest cadence
+        # beside the SLO engine (obs/capacity.py)
+        from sparkrdma_tpu.obs.capacity import CapacityPlane
+
+        if conf is not None:
+            cap_conf = conf
+        else:
+            from sparkrdma_tpu.utils.config import TpuShuffleConf
+
+            cap_conf = TpuShuffleConf()
+        self.capacity = CapacityPlane(
+            cap_conf, self._registry, role=role, clock=clock
+        )
         # last critical-path TimeBreakdown the engine attributed — the
         # diagnosis engine's dominant-category evidence (obs/attr.py)
         self.last_breakdown: Optional[dict] = None
@@ -451,12 +508,21 @@ class TelemetryHub:
                 self.profiles.ingest(exec_id, profile, wall_ms=wall_ms)
             except (KeyError, TypeError, ValueError):
                 self._c_bad.inc()
+        events = payload.get("journal")
+        if events:
+            try:
+                # idempotent + gap-tolerant merge; folds each event's
+                # HLC into the hub process's clock (message receive)
+                self.journal.ingest(events)
+            except (KeyError, TypeError, ValueError):
+                self._c_bad.inc()
         self._registry.counter(
             "telemetry.heartbeats", role=self.role, executor=exec_id
         ).inc()
         self.check_missed(now_ms=wall_ms)
         self._update_stragglers()
         self.slo.maybe_evaluate(now_ms=wall_ms)
+        self.capacity.maybe_evaluate(now_ms=wall_ms)
         self._maybe_write_file(wall_ms)
 
     def check_missed(self, now_ms: Optional[int] = None) -> List[str]:
@@ -570,6 +636,8 @@ class TelemetryHub:
             "stragglers": list(self._last_report.get("stragglers", [])),
             "missed_heartbeats": self._g_missed.value,
             "profile": self.profiles.summary(),
+            "journal": self.journal.summary(),
+            "capacity": self.capacity.summary(),
         }
 
     # -- straggler / skew detection ------------------------------------
@@ -682,6 +750,11 @@ class TelemetryHub:
         report = self.straggler_report()
         flagged = set(report["stragglers"])
         known = set(report["executors"])
+        prev = set(self._last_report.get("stragglers", ()))
+        for eid in sorted(flagged - prev):
+            _journal.emit("straggler.flag", role=self.role, executor=eid)
+        for eid in sorted(prev - flagged):
+            _journal.emit("straggler.clear", role=self.role, executor=eid)
         self._g_stragglers.set(len(flagged))
         for eid in known:
             self._registry.gauge(
@@ -743,6 +816,11 @@ class TelemetryHub:
                 self._health.states() if self._health is not None else {}
             ),
             "slo": self.slo.summary(),
+            # last-N merged journal events around the failure: the
+            # causally-ordered incident context (obs/journal.py);
+            # rendered by `python -m sparkrdma_tpu.obs --timeline`
+            "journal": self.journal.merged(last=self.journal_flight_events),
+            "capacity": self.capacity.capacity_report(refresh=True),
         }
         # last profile window per executor: the collapsed-stack view of
         # what each process's CPUs were doing just before the failure

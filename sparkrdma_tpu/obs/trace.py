@@ -328,12 +328,16 @@ def collect_spans_with_epochs(
 
 
 def to_chrome_trace(tracers: Optional[Iterable[Tracer]] = None,
-                    epochs: Optional[Dict[str, float]] = None) -> Dict:
+                    epochs: Optional[Dict[str, float]] = None,
+                    journal_events: Optional[Iterable[Dict]] = None) -> Dict:
     """Chrome trace-event JSON dict: one complete event ("ph": "X") per
     span, one pid per tracer role (with process_name metadata), tids
     mapped to small ints per role, and one Perfetto flow-event pair
     (``ph:"s"`` / ``ph:"f"``) per causal ``follows`` edge whose origin
-    span is part of this export."""
+    span is part of this export. ``journal_events`` (merged cluster
+    journal dicts, obs/journal.py) draw as instant markers (``ph:"i"``)
+    on the same wall-clock timeline — spans already use wall-anchored
+    timestamps, so the two align without translation."""
     events: List[Dict] = []
     pids: Dict[str, int] = {}
     tids: Dict[tuple, int] = {}
@@ -384,19 +388,29 @@ def to_chrome_trace(tracers: Optional[Iterable[Tracer]] = None,
                 "pid": pid, "tid": tid,
                 "args": {"from_span": osp.span_id, "to_span": sp.span_id},
             })
+    instants: List[Dict] = []
+    if journal_events:
+        from sparkrdma_tpu.obs.journal import events_to_chrome
+
+        jpid = len(pids) + 1
+        instants = events_to_chrome(journal_events, pid=jpid)
+        pids["journal"] = jpid
     meta = [
         {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
          "args": {"name": role}}
         for role, pid in sorted(pids.items(), key=lambda kv: kv[1])
     ]
-    return {"traceEvents": meta + events + flows, "displayTimeUnit": "ms"}
+    return {"traceEvents": meta + events + flows + instants,
+            "displayTimeUnit": "ms"}
 
 
 def export_chrome_trace(path: str,
                         tracers: Optional[Iterable[Tracer]] = None,
-                        epochs: Optional[Dict[str, float]] = None) -> Dict:
+                        epochs: Optional[Dict[str, float]] = None,
+                        journal_events: Optional[Iterable[Dict]] = None
+                        ) -> Dict:
     """Write the Chrome trace JSON to ``path`` and return the dict."""
-    doc = to_chrome_trace(tracers, epochs)
+    doc = to_chrome_trace(tracers, epochs, journal_events=journal_events)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     return doc

@@ -68,6 +68,7 @@ STRING_METADATA_KEYS = {
     "executor_id",
     "map_sorter",
     "gate_skip_reason",
+    "resource",  # capacity_report binding/row names (obs/capacity.py)
 }
 
 # Numeric keys that describe the run rather than measure it (round index,

@@ -26,6 +26,7 @@ from typing import Callable, Dict, Optional
 
 from sparkrdma_tpu import tenancy
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 
 logger = logging.getLogger(__name__)
 
@@ -162,13 +163,23 @@ class SourceHealthRegistry:
             return br
 
     def allow(self, executor_id: str, tenant: Optional[str] = None) -> bool:
-        return self.get(executor_id, tenant).allow()
+        br = self.get(executor_id, tenant)
+        was_half_open = br.state == HALF_OPEN
+        ok = br.allow()
+        if ok and was_half_open:
+            journal_emit(
+                "circuit.half_open", role=self._role, executor=executor_id,
+            )
+        return ok
 
     def record_success(
         self, executor_id: str, tenant: Optional[str] = None
     ) -> None:
         if self.get(executor_id, tenant).record_success():
             self._m_close.inc()
+            journal_emit(
+                "circuit.close", role=self._role, executor=executor_id,
+            )
             logger.info("circuit to %s closed (probe succeeded)", executor_id)
 
     def record_failure(
@@ -176,6 +187,9 @@ class SourceHealthRegistry:
     ) -> None:
         if self.get(executor_id, tenant).record_failure():
             self._m_open.inc()
+            journal_emit(
+                "circuit.open", role=self._role, executor=executor_id,
+            )
             logger.warning(
                 "circuit to %s opened after consecutive failures",
                 self._key(executor_id, tenant),

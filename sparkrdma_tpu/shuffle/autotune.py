@@ -34,6 +34,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 from sparkrdma_tpu.ops.exchange import round_bucket
 
 logger = logging.getLogger(__name__)
@@ -81,6 +82,7 @@ class WaveAutoTuner:
 
     def __init__(self, conf, executor_id: str):
         self._conf = conf
+        self._executor_id = executor_id
         self._lock = threading.Lock()
         self._choices: Dict[Tuple, int] = {}
         reg = get_registry()
@@ -121,6 +123,10 @@ class WaveAutoTuner:
             self._choices[sig] = target
         self._m_adjust.inc()
         self._m_tuned.set(target)
+        journal_emit(
+            "autotune.adjust", role=self._executor_id,
+            prev=prev or 0, wave_bytes=target, waves=report.waves,
+        )
         logger.debug(
             "autotune: stage %r waveBytes %s -> %d (waves=%d depth=%d "
             "dispatch=%.2fms wall=%.2fms overlap=%.2fms)",

@@ -34,6 +34,7 @@ from sparkrdma_tpu.locations import PartitionLocation, ShuffleManagerId
 from sparkrdma_tpu.metastore import ShardedMetaStore, StaleEpochError
 from sparkrdma_tpu.obs import SpanHandle, Tracer, get_registry, mint_trace_id
 from sparkrdma_tpu.obs import now as obs_now
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 from sparkrdma_tpu.obs.telemetry import TelemetryHub
 from sparkrdma_tpu.resilience import SourceHealthRegistry
 from sparkrdma_tpu.tenancy import AdmissionController, FairShareExecutor
@@ -560,6 +561,10 @@ class TpuShuffleManager:
                 self.registry.counter(
                     "metastore.adoptions", role=self.executor_id
                 ).inc()
+                journal_emit(
+                    "meta.adopt", role=self.executor_id, executor=exec_id,
+                    shuffle_id=msg.shuffle_id, generation=msg.meta_epoch,
+                )
             with self._shuffle_lock(msg.shuffle_id):
                 with self._lock:
                     handle = self._registered.get(msg.shuffle_id)
@@ -768,6 +773,12 @@ class TpuShuffleManager:
                 self.registry.counter(
                     "elastic.replica_promotions", role=self.executor_id
                 ).inc(len(promoted_maps))
+                journal_emit(
+                    "elastic.promote", role=self.executor_id,
+                    executor=executor_id, shuffle_id=shuffle_id,
+                    maps=len(promoted_maps),
+                    holders=len(promoted_by_holder),
+                )
         logger.info("pruned locations of lost executor %s", executor_id)
 
     # ------------------------------------------------------------------
@@ -880,6 +891,7 @@ class TpuShuffleManager:
         Returns the new generation; re-adoption sweeps
         (:meth:`republish_for_readoption`) must carry it."""
         assert self.is_driver and self.metastore is not None
+        journal_emit("driver.kill", role=self.executor_id)
         generation = self.metastore.wipe()
         with self._lock:
             self._maps_done.clear()

@@ -20,6 +20,7 @@ from typing import Deque, Iterator, Optional
 
 from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 
 
 class AdmissionTimeout(RuntimeError):
@@ -83,6 +84,10 @@ class AdmissionController:
                 self._waiters.append(w)
                 self._g_queue.set(len(self._waiters))
                 self._m_queued(tenant).inc()
+                journal_emit(
+                    "admission.enqueue", tenant=tenant,
+                    queue_depth=len(self._waiters), inflight=self._inflight,
+                )
                 deadline = t0 + timeout_s
                 while not w.admitted:
                     if self._closed:
@@ -95,6 +100,10 @@ class AdmissionController:
                         self._waiters.remove(w)
                         self._g_queue.set(len(self._waiters))
                         self._m_timeouts(tenant).inc()
+                        journal_emit(
+                            "admission.deadline", tenant=tenant,
+                            waited_ms=round(timeout_s * 1e3),
+                        )
                         raise AdmissionTimeout(
                             f"tenant {tenant!r} job queued past its "
                             f"{timeout_s * 1e3:.0f} ms admission deadline"

@@ -32,6 +32,7 @@ from typing import Dict, Optional
 from sparkrdma_tpu.analysis.lockorder import named_lock
 from sparkrdma_tpu.analysis.modelcheck import schedule_point
 from sparkrdma_tpu.obs import get_registry
+from sparkrdma_tpu.obs.journal import emit as journal_emit
 
 logger = logging.getLogger(__name__)
 
@@ -53,6 +54,7 @@ class QuotaBroker:
         self._lock = named_lock(f"quota.{resource}")
         self._cond = threading.Condition(self._lock)
         self._usage: Dict[str, int] = {}
+        self._waiting = 0  # threads currently blocked at this quota
         reg = get_registry()
         self._m_blocks = lambda t: reg.counter(
             "tenant.quota_blocks", tenant=t, resource=resource
@@ -73,6 +75,22 @@ class QuotaBroker:
     def usage(self, tenant: str) -> int:
         with self._lock:
             return self._usage.get(tenant, 0)
+
+    def waiting(self) -> int:
+        """Threads blocked at this quota right now — a nonzero value
+        means the resource is at 100% utilization regardless of how the
+        held-bytes ledger reads between charges (capacity plane)."""
+        with self._lock:
+            return self._waiting
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant ``{usage, quota}`` view (capacity plane input)."""
+        with self._lock:
+            held = dict(self._usage)
+        return {
+            t: {"usage": u, "quota": self.quota_for(t)}
+            for t, u in held.items()
+        }
 
     def over_quota(self, tenant: str) -> bool:
         q = self.quota_for(tenant)
@@ -105,9 +123,18 @@ class QuotaBroker:
                     if blocked_at is None:
                         blocked_at = now
                         deadline = now + self._block_max_s
+                        self._waiting += 1
                         self._m_blocks(tenant).inc()
+                        journal_emit(
+                            "quota.block", tenant=tenant,
+                            resource=self.resource, bytes=nbytes,
+                        )
                     if now >= deadline:
                         self._m_overruns(tenant).inc()
+                        journal_emit(
+                            "quota.overrun", tenant=tenant,
+                            resource=self.resource, bytes=nbytes,
+                        )
                         logger.warning(
                             "tenant %s overran its %s quota wait "
                             "(%.0f ms); admitting %d bytes anyway",
@@ -116,11 +143,16 @@ class QuotaBroker:
                         )
                         break
                     self._cond.wait(deadline - now)
+                if blocked_at is not None:
+                    self._waiting -= 1
             self._usage[tenant] = self._usage.get(tenant, 0) + nbytes
             self._g_bytes(tenant).set(self._usage[tenant])
         if blocked_at is not None:
-            self._h_wait(tenant).observe(
-                (time.perf_counter() - blocked_at) * 1e3
+            wait_ms = (time.perf_counter() - blocked_at) * 1e3
+            self._h_wait(tenant).observe(wait_ms)
+            journal_emit(
+                "quota.release", tenant=tenant, resource=self.resource,
+                bytes=nbytes, wait_ms=round(wait_ms, 1),
             )
 
     def release(self, tenant: str, nbytes: int) -> None:

@@ -91,6 +91,11 @@ DECLARED_KNOBS: Dict[str, str] = {
     "obs.slo.slowWindows": "slow-burn horizon in ring windows",
     "obs.slo.fastBurn": "burn-rate multiple that pages",
     "obs.slo.slowBurn": "burn-rate multiple that warns",
+    "obs.journal.enabled": "HLC-ordered cluster event journal",
+    "obs.journal.ringSize": "events retained per process journal",
+    "obs.journal.flightEvents": "merged events per flight record",
+    "obs.capacity.enabled": "USE-method capacity plane on the hub",
+    "obs.capacity.evalIntervalMs": "min period between USE evaluations",
     "driverHost": "driver RPC host",
     "driverPort": "driver RPC port (0 = ephemeral, written back)",
     "executorPort": "executor listener port (0 = ephemeral)",
@@ -490,6 +495,34 @@ class TpuShuffleConf:
         ``obs.slo.taskP99Ms`` (0 = no objective for that tenant)."""
         return self._int(f"obs.slo.tenant.{tenant}.taskP99Ms",
                          self.slo_task_p99_ms, 0, 600000)
+
+    # -- cluster event journal + capacity plane (obs/journal.py,
+    #    obs/capacity.py; docs/OBSERVABILITY.md)
+    @property
+    def journal_enabled(self) -> bool:
+        """HLC-ordered cluster event journal; off leaves every
+        ``journal.emit`` call site a single None check."""
+        return self._bool("obs.journal.enabled", True)
+
+    @property
+    def journal_ring_size(self) -> int:
+        """Events retained per process journal (hub merge keeps 4x)."""
+        return self._int("obs.journal.ringSize", 512, 8, 65536)
+
+    @property
+    def journal_flight_events(self) -> int:
+        """Merged journal events attached to each flight record."""
+        return self._int("obs.journal.flightEvents", 64, 1, 4096)
+
+    @property
+    def capacity_enabled(self) -> bool:
+        """USE-method capacity accounting on the telemetry hub."""
+        return self._bool("obs.capacity.enabled", True)
+
+    @property
+    def capacity_eval_interval_ms(self) -> int:
+        """Minimum period between hub-side USE evaluations."""
+        return self._int("obs.capacity.evalIntervalMs", 2000, 10, 3600000)
 
     # -- endpoints / connection management (RdmaShuffleConf.scala:118-126)
     @property

@@ -287,7 +287,7 @@ def test_trend_covers_every_committed_bench_round():
     from sparkrdma_tpu.obs.trend import build_trend
 
     trend = build_trend(str(REPO_ROOT))
-    assert trend["rounds"]["bench"] == [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    assert trend["rounds"]["bench"] == [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
     assert not trend["errors"], trend["errors"]
     assert not trend["regressions"], trend["regressions"]
     assert trend["num_series"] > 100
